@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device CPU platform.
+
+The analogue of the reference's run-distributed-tests-on-CPU-CI trick
+(``test_utils.py:227-265`` launches gloo ranks): a virtual 8-device CPU mesh
+lets sharded/replicated/resharding paths run anywhere. Multi-process elastic
+tests additionally spawn real processes (see ``torchsnapshot_tpu/test_utils.py``).
+
+Note: the env vars must be set before jax initializes its backend, and the
+``jax.config.update`` call is additionally required because TPU platform
+plugins (e.g. axon) can override ``JAX_PLATFORMS`` during plugin registration.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

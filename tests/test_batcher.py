@@ -1,0 +1,71 @@
+"""Slab batching round-trips (reference model: ``tests/test_batcher.py``)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.batcher import batch_read_requests
+from torchsnapshot_tpu.io_types import ReadReq
+from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+from torchsnapshot_tpu.utils import knobs
+
+
+def test_batched_take_restore(tmp_path) -> None:
+    rng = np.random.default_rng(0)
+    sd = StateDict(
+        **{f"p{i}": rng.standard_normal((7, 5)).astype(np.float32) for i in range(20)}
+    )
+    expected = dict(sd)
+    path = str(tmp_path / "ckpt")
+    with knobs.override_batching_enabled(True), knobs.override_slab_size_threshold_bytes(
+        400
+    ):
+        snap = Snapshot.take(path, {"s": sd})
+        out = StateDict()
+        Snapshot(path).restore({"s": out})
+    assert_state_dict_eq(dict(out), expected, exact=True)
+    # Entries must have been relocated into slab objects with byte ranges.
+    manifest = snap.get_manifest()
+    slabbed = [
+        e
+        for k, e in manifest.items()
+        if getattr(e, "location", "").startswith("batched/")
+    ]
+    assert len(slabbed) == 20
+    assert all(e.byte_range is not None for e in slabbed)
+    # Multiple params share a slab object.
+    assert len({e.location for e in slabbed}) < 20
+
+
+def test_batched_read_object(tmp_path) -> None:
+    sd = StateDict(a=np.arange(10, dtype=np.int32), b=np.ones(4, dtype=np.float64))
+    path = str(tmp_path / "ckpt")
+    with knobs.override_batching_enabled(True), knobs.override_slab_size_threshold_bytes(
+        10**6
+    ):
+        Snapshot.take(path, {"s": sd})
+    got = Snapshot(path).read_object("0/s/a")
+    assert np.array_equal(got, sd["a"])
+
+
+def test_read_merge_adjacent() -> None:
+    class DummyConsumer:
+        def __init__(self):
+            self.got = None
+
+        async def consume_buffer(self, buf, executor=None):
+            self.got = bytes(buf)
+
+        def get_consuming_cost_bytes(self):
+            return 4
+
+    c1, c2, c3 = DummyConsumer(), DummyConsumer(), DummyConsumer()
+    reqs = [
+        ReadReq("x", c1, (0, 4)),
+        ReadReq("x", c2, (4, 8)),
+        ReadReq("x", c3, (12, 16)),  # gap: not merged
+    ]
+    merged = batch_read_requests(reqs)
+    assert len(merged) == 2
+    spans = sorted(r.byte_range for r in merged)
+    assert spans == [(0, 8), (12, 16)]

@@ -1,0 +1,75 @@
+"""Flatten/inflate round-trips incl. adversarial keys
+(reference model: ``tests/test_flatten.py``)."""
+
+from collections import OrderedDict
+
+import numpy as np
+
+from torchsnapshot_tpu.flatten import flatten, inflate
+
+
+def _roundtrip(obj, prefix=""):
+    manifest, flattened = flatten(obj, prefix=prefix)
+    return inflate(manifest, flattened, prefix=prefix)
+
+
+def test_basic_nested() -> None:
+    obj = {
+        "model": {"w": np.ones(3), "b": np.zeros(2)},
+        "steps": [1, 2, {"nested": "x"}],
+        "od": OrderedDict([("z", 1), ("a", 2)]),
+    }
+    out = _roundtrip(obj)
+    assert list(out["od"].keys()) == ["z", "a"]
+    assert out["steps"][2]["nested"] == "x"
+    assert np.array_equal(out["model"]["w"], obj["model"]["w"])
+
+
+def test_adversarial_keys() -> None:
+    obj = {"a/b": 1, "a%2Fb": 2, "a": {"b": 3}, "%": {"%%": 4}}
+    manifest, flattened = flatten(obj)
+    assert len(flattened) == 4
+    out = inflate(manifest, flattened)
+    assert out == obj
+
+
+def test_int_keys() -> None:
+    obj = {1: "one", "1x": "strtwo", "d": {0: [10, 20]}}
+    out = _roundtrip(obj)
+    assert out == obj
+    assert 1 in out and isinstance(list(out.keys())[0], int)
+
+
+def test_colliding_keys_kept_opaque() -> None:
+    obj = {"outer": {1: "int_one", "1": "str_one"}}
+    manifest, flattened = flatten(obj)
+    # The colliding dict is not descended into: it stays one opaque leaf.
+    assert flattened["outer"] == {1: "int_one", "1": "str_one"}
+    assert inflate(manifest, flattened) == obj
+
+
+def test_non_str_int_keys_kept_opaque() -> None:
+    obj = {"outer": {(1, 2): "tuple_key"}, "ok": 5}
+    manifest, flattened = flatten(obj)
+    assert flattened["outer"] == {(1, 2): "tuple_key"}
+    assert inflate(manifest, flattened) == obj
+
+
+def test_empty_containers() -> None:
+    obj = {"e1": {}, "e2": [], "e3": OrderedDict()}
+    out = _roundtrip(obj)
+    assert out == obj
+    assert isinstance(out["e3"], OrderedDict)
+
+
+def test_prefix() -> None:
+    obj = {"w": 1}
+    manifest, flattened = flatten(obj, prefix="app")
+    assert "app/w" in flattened
+    assert inflate(manifest, flattened, prefix="app") == obj
+
+
+def test_leaf_at_root() -> None:
+    manifest, flattened = flatten(42, prefix="x")
+    assert manifest == {} and flattened == {"x": 42}
+    assert inflate(manifest, flattened, prefix="x") == 42

@@ -1,0 +1,125 @@
+"""Manifest JSON round-trip + per-rank projection
+(reference model: ``tests/test_manifest.py:33-60``)."""
+
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    get_manifest_for_rank,
+)
+
+
+def _two_rank_metadata() -> SnapshotMetadata:
+    def shard(path, off, sz):
+        return Shard(
+            offsets=off,
+            sizes=sz,
+            tensor=ArrayEntry(path, "raw", "float32", sz),
+        )
+
+    manifest = {
+        "0/app": DictEntry(keys=["per_rank", "repl", "shard", "obj"]),
+        "1/app": DictEntry(keys=["per_rank", "repl", "shard"]),
+        "0/app/per_rank": ArrayEntry("0/app/per_rank", "raw", "float32", [4]),
+        "1/app/per_rank": ArrayEntry("1/app/per_rank", "raw", "float32", [4]),
+        "0/app/repl": ArrayEntry("replicated/app/repl", "raw", "int64", [2], True),
+        "1/app/repl": ArrayEntry("replicated/app/repl", "raw", "int64", [2], True),
+        "0/app/shard": ShardedArrayEntry(
+            "float32", [8, 4], [shard("sharded/app/shard.0_0", [0, 0], [4, 4])]
+        ),
+        "1/app/shard": ShardedArrayEntry(
+            "float32", [8, 4], [shard("sharded/app/shard.4_0", [4, 0], [4, 4])]
+        ),
+        "0/app/obj": ObjectEntry("0/app/obj"),
+        "0/prim": PrimitiveEntry.from_value(42),
+    }
+    return SnapshotMetadata(version="0", world_size=2, manifest=manifest)
+
+
+def test_json_roundtrip() -> None:
+    md = _two_rank_metadata()
+    md2 = SnapshotMetadata.from_json(md.to_json())
+    assert md2.world_size == 2
+    assert set(md2.manifest.keys()) == set(md.manifest.keys())
+    e = md2.manifest["0/app/shard"]
+    assert isinstance(e, ShardedArrayEntry)
+    assert e.shards[0].offsets == [0, 0] and e.shards[0].sizes == [4, 4]
+    assert md2.manifest["0/prim"].get_value() == 42
+    assert md2.manifest["0/app/repl"].replicated is True
+
+
+def test_primitive_roundtrip_exact() -> None:
+    for v in [0, -3, 1.5, float("inf"), 0.1, True, False, "hi", b"\x00\xff", 1 + 2j, None]:
+        e = PrimitiveEntry.from_value(v)
+        e2 = SnapshotMetadata.from_json(
+            SnapshotMetadata(version="0", world_size=1, manifest={"0/x": e}).to_json()
+        ).manifest["0/x"]
+        out = e2.get_value()
+        assert out == v and type(out) is type(v)
+
+
+def test_manifest_for_existing_rank() -> None:
+    md = _two_rank_metadata()
+    m0 = get_manifest_for_rank(md, 0)
+    assert m0["app/per_rank"].location == "0/app/per_rank"
+    assert m0["app/repl"].replicated
+    assert len(m0["app/shard"].shards) == 2  # merged across ranks
+    assert "app/obj" in m0
+    assert "prim" in m0
+
+    m1 = get_manifest_for_rank(md, 1)
+    assert m1["app/per_rank"].location == "1/app/per_rank"
+    assert len(m1["app/shard"].shards) == 2
+    assert "app/obj" not in m1  # per-rank value of rank 0
+    assert "prim" not in m1
+
+
+def test_manifest_for_new_rank() -> None:
+    """A newly joined rank (elastic scale-up) sees replicated + sharded."""
+    md = _two_rank_metadata()
+    m5 = get_manifest_for_rank(md, 5)
+    assert "app/per_rank" not in m5
+    assert m5["app/repl"].replicated
+    assert len(m5["app/shard"].shards) == 2
+    # Parent containers reconstructed for inflate.
+    assert "app" in m5 and "app/repl" in m5
+
+
+def test_chunked_entry_roundtrip() -> None:
+    entry = ChunkedArrayEntry(
+        "bfloat16",
+        [10, 4],
+        [
+            Shard([0, 0], [5, 4], ArrayEntry("0/x.chunk_0", "raw", "bfloat16", [5, 4])),
+            Shard([5, 0], [5, 4], ArrayEntry("0/x.chunk_5", "raw", "bfloat16", [5, 4])),
+        ],
+        replicated=True,
+    )
+    md = SnapshotMetadata(version="0", world_size=1, manifest={"0/x": entry})
+    e2 = SnapshotMetadata.from_json(md.to_json()).manifest["0/x"]
+    assert isinstance(e2, ChunkedArrayEntry)
+    assert e2.replicated and len(e2.chunks) == 2
+    assert e2.chunks[1].offsets == [5, 0]
+
+
+def test_container_entries_roundtrip() -> None:
+    md = SnapshotMetadata(
+        version="0",
+        world_size=1,
+        manifest={
+            "0/l": ListEntry(),
+            "0/od": OrderedDictEntry(keys=["b", "a"]),
+            "0/d": DictEntry(keys=[1, "x"]),
+        },
+    )
+    m2 = SnapshotMetadata.from_json(md.to_json()).manifest
+    assert m2["0/l"].type == "list"
+    assert m2["0/od"].keys == ["b", "a"] and m2["0/od"].type == "ordered_dict"
+    assert m2["0/d"].keys == [1, "x"]

@@ -1,0 +1,69 @@
+"""Round-trip every supported dtype through the raw codec
+(reference test model: ``tests/test_serialization.py``)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.serialization import (
+    SUPPORTED_DTYPES,
+    array_as_bytes_view,
+    array_from_bytes,
+    array_nbytes,
+    dtype_to_string,
+    is_raw_serializable,
+    string_to_dtype,
+)
+from torchsnapshot_tpu.test_utils import rand_array
+
+
+@pytest.mark.parametrize("dtype", sorted(SUPPORTED_DTYPES.keys()))
+def test_raw_roundtrip(dtype: str) -> None:
+    arr = rand_array((16, 9), dtype=dtype, seed=42)
+    buf = array_as_bytes_view(arr)
+    assert buf.nbytes == array_nbytes(arr.shape, dtype)
+    out = array_from_bytes(bytes(buf), dtype, arr.shape)
+    assert out.dtype == arr.dtype
+    assert np.array_equal(
+        arr.reshape(-1).view(np.uint8), out.reshape(-1).view(np.uint8)
+    )
+
+
+@pytest.mark.parametrize("dtype", sorted(SUPPORTED_DTYPES.keys()))
+def test_dtype_table_roundtrip(dtype: str) -> None:
+    assert dtype_to_string(string_to_dtype(dtype)) == dtype
+    assert is_raw_serializable(string_to_dtype(dtype))
+
+
+def test_zero_copy() -> None:
+    arr = np.arange(100, dtype=np.float32)
+    view = array_as_bytes_view(arr)
+    arr[0] = 42.0  # the view must alias the array's memory
+    assert array_from_bytes(view, "float32", arr.shape)[0] == 42.0
+
+
+def test_noncontiguous_input() -> None:
+    arr = np.arange(100, dtype=np.int32).reshape(10, 10).T
+    buf = array_as_bytes_view(arr)
+    out = array_from_bytes(bytes(buf), "int32", (10, 10))
+    assert np.array_equal(out, arr)
+
+
+def test_0d_and_empty() -> None:
+    for arr in [np.float32(3.5).reshape(()), np.empty((0, 4), dtype=np.int64)]:
+        arr = np.asarray(arr)
+        buf = array_as_bytes_view(arr)
+        out = array_from_bytes(bytes(buf), dtype_to_string(arr.dtype), arr.shape)
+        assert np.array_equal(out, arr)
+
+
+def test_jax_dtypes_covered() -> None:
+    """Every dtype jax can put on a TPU must be raw-serializable."""
+    import jax.numpy as jnp
+
+    for dt in [jnp.bfloat16, jnp.float32, jnp.int8, jnp.float8_e4m3fn, jnp.int4]:
+        assert is_raw_serializable(np.dtype(dt))
+
+
+def test_size_mismatch_raises() -> None:
+    with pytest.raises(ValueError):
+        array_from_bytes(b"\x00" * 7, "float32", (2,))

@@ -1,0 +1,201 @@
+"""End-to-end single-process take/restore/read_object
+(reference model: ``tests/test_snapshot.py`` + ``examples/simple_example.py``)."""
+
+import os
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import RNGState, Snapshot, StateDict
+from torchsnapshot_tpu.test_utils import assert_state_dict_eq, check_state_dict_eq
+from torchsnapshot_tpu.utils import knobs
+
+
+class _Model:
+    """A minimal Stateful holding jax + numpy + primitive state."""
+
+    def __init__(self, seed: int = 0):
+        k = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(k)
+        self.w = jax.random.normal(k1, (8, 16), dtype=jnp.float32)
+        self.b = jax.random.normal(k2, (16,), dtype=jnp.bfloat16)
+        self.buf = np.arange(12, dtype=np.int64).reshape(3, 4)
+        self.step = 7
+
+    def state_dict(self):
+        return {"w": self.w, "b": self.b, "buf": self.buf, "step": self.step}
+
+    def load_state_dict(self, sd):
+        self.w, self.b, self.buf, self.step = sd["w"], sd["b"], sd["buf"], sd["step"]
+
+
+def test_take_restore_bit_exact(tmp_path) -> None:
+    model = _Model(seed=0)
+    progress = StateDict(epoch=3, history=[1.0, 0.5, 0.25])
+    app_state = {"model": model, "progress": progress}
+    expected = {k: v.state_dict() for k, v in app_state.items()}
+    expected = jax.tree.map(lambda x: x, expected)  # deep copy of structure
+
+    snapshot = Snapshot.take(str(tmp_path / "ckpt"), app_state)
+
+    # Clobber and restore.
+    model2 = _Model(seed=99)
+    progress2 = StateDict()
+    Snapshot(str(tmp_path / "ckpt")).restore({"model": model2, "progress": progress2})
+
+    assert_state_dict_eq(model2.state_dict(), expected["model"], exact=True)
+    assert progress2["epoch"] == 3 and progress2["history"] == [1.0, 0.5, 0.25]
+    assert isinstance(model2.w, jax.Array)
+    assert model2.b.dtype == jnp.bfloat16
+    assert isinstance(model2.step, int)
+
+
+def test_metadata_commit_is_last(tmp_path) -> None:
+    path = tmp_path / "ckpt"
+    Snapshot.take(str(path), {"s": StateDict(x=1)})
+    assert (path / ".snapshot_metadata").exists()
+    snap = Snapshot(str(path))
+    assert snap.metadata.world_size == 1
+    assert any(k.endswith("s/x") for k in snap.get_manifest())
+
+
+def test_read_object(tmp_path) -> None:
+    model = _Model(seed=1)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"model": model, "sd": StateDict(lr=0.1, name="adam")})
+    snap = Snapshot(path)
+
+    w = snap.read_object("0/model/w")
+    assert np.allclose(np.asarray(w), np.asarray(model.w))
+    assert snap.read_object("0/sd/lr") == 0.1
+    assert snap.read_object("0/sd/name") == "adam"
+    step = snap.read_object("0/model/step")
+    assert step == 7
+
+    # In-place into a numpy target.
+    out = np.zeros((3, 4), dtype=np.int64)
+    got = snap.read_object("0/model/buf", obj_out=out)
+    assert np.array_equal(out, model.buf)
+
+
+def test_read_object_with_memory_budget(tmp_path) -> None:
+    arr = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(big=arr)})
+    got = Snapshot(path).read_object("0/s/big", memory_budget_bytes=1000)
+    assert np.array_equal(got, arr)
+
+
+def test_chunked_roundtrip(tmp_path) -> None:
+    with knobs.override_max_chunk_size_bytes(512):
+        arr = np.random.default_rng(0).standard_normal((64, 8)).astype(np.float32)
+        jarr = jnp.asarray(np.random.default_rng(1).standard_normal((100, 4)), dtype=jnp.float32)
+        path = str(tmp_path / "ckpt")
+        Snapshot.take(path, {"s": StateDict(a=arr, j=jarr)})
+        snap = Snapshot(path)
+        target = StateDict()
+        snap.restore({"s": target})
+        assert np.array_equal(target["a"], arr)
+        assert np.array_equal(np.asarray(target["j"]), np.asarray(jarr))
+        # More than one storage object must exist for each array.
+        entry = snap.get_manifest()["0/s/a"]
+        assert entry.type == "chunked_array" and len(entry.chunks) > 1
+
+
+class Custom:
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return isinstance(other, Custom) and other.v == self.v
+
+
+def test_arbitrary_object_roundtrip(tmp_path) -> None:
+    sd = StateDict(obj=Custom([1, 2, 3]), tup=(1, "two"), s={1, 2})
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": sd})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert out["obj"] == Custom([1, 2, 3])
+    assert out["tup"] == (1, "two")
+    assert out["s"] == {1, 2}
+
+
+def test_rng_state_invariant(tmp_path) -> None:
+    """Restored RNG state equals the state at the start of take()."""
+    import random
+
+    rng_state = RNGState()
+    path = str(tmp_path / "ckpt")
+    random.seed(1234)
+    np.random.seed(5678)
+    expected_py = random.random()
+    expected_np = np.random.rand()
+    # Rewind and take: taking must not perturb the sequence.
+    random.seed(1234)
+    np.random.seed(5678)
+    Snapshot.take(path, {"rng": rng_state})
+    assert random.random() == expected_py
+    assert np.random.rand() == expected_np
+
+    # Restoring reinstates the start-of-take state.
+    random.seed(1)
+    np.random.seed(2)
+    Snapshot(path).restore({"rng": rng_state})
+    assert random.random() == expected_py
+    assert np.random.rand() == expected_np
+
+
+def test_nested_ordered_dict(tmp_path) -> None:
+    sd = StateDict(od=OrderedDict([("z", np.ones(2)), ("a", OrderedDict([("k", 1)]))]))
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": sd})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert list(out["od"].keys()) == ["z", "a"]
+    assert isinstance(out["od"], OrderedDict)
+    assert out["od"]["a"]["k"] == 1
+
+
+def test_all_dtypes_end_to_end(tmp_path) -> None:
+    from torchsnapshot_tpu.serialization import SUPPORTED_DTYPES
+    from torchsnapshot_tpu.test_utils import rand_array
+
+    sd = StateDict(
+        **{f"x_{dt}": rand_array((5, 3), dt, seed=7) for dt in SUPPORTED_DTYPES}
+    )
+    expected = dict(sd)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": sd})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert_state_dict_eq(dict(out), expected, exact=True)
+
+
+def test_in_place_numpy_restore(tmp_path) -> None:
+    arr = np.arange(10, dtype=np.float64)
+    sd = StateDict(a=arr)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": sd})
+    arr[:] = -1.0
+    Snapshot(path).restore({"s": sd})
+    # The same buffer must have been filled in place.
+    assert sd["a"] is arr
+    assert np.array_equal(arr, np.arange(10, dtype=np.float64))
+
+
+def test_pickle_dtype_roundtrip(tmp_path) -> None:
+    """Arrays with non-raw dtypes (datetime64, object) restore via pickle."""
+    dates = np.array(["2026-07-29", "2026-01-01"], dtype="datetime64[D]")
+    objs = np.array([{"a": 1}, None], dtype=object)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(dates=dates, objs=objs)})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert np.array_equal(out["dates"], dates)
+    assert out["objs"][0] == {"a": 1} and out["objs"][1] is None
+    got = Snapshot(path).read_object("0/s/dates")
+    assert np.array_equal(got, dates)

@@ -1,0 +1,31 @@
+"""torchsnapshot_tpu — a TPU-native checkpointing framework for JAX.
+
+A performant, memory-efficient checkpointing library for large distributed
+JAX/XLA workloads, providing the full capability surface of torchsnapshot
+(reference: ``/root/reference``) re-designed TPU-first: GSPMD shardings are
+the source of truth for replication/sharding, device-to-host transfers
+overlap storage I/O under a memory budget, write load is partitioned across
+processes, and snapshots are elastic across mesh shapes and process counts.
+
+The public interface is deliberately tiny (reference ``__init__.py:35-41``):
+``Snapshot``, ``PendingSnapshot``, ``Stateful``, ``StateDict``, ``RNGState``.
+``StoragePlugin`` is the semi-public storage extension point.
+"""
+
+from .io_types import StoragePlugin
+from .rng_state import RNGState
+from .snapshot import PendingSnapshot, Snapshot
+from .state_dict import StateDict
+from .stateful import AppState, Stateful
+from .version import __version__
+
+__all__ = [
+    "Snapshot",
+    "PendingSnapshot",
+    "Stateful",
+    "StateDict",
+    "RNGState",
+    "AppState",
+    "StoragePlugin",
+    "__version__",
+]

@@ -1,0 +1,213 @@
+"""Small-write batching: coalesce many small arrays into slab objects.
+
+Analogue of the reference's ``batcher.py:49-482``. Storage backends (cloud
+object stores especially) pay a fixed per-object cost; a model with thousands
+of small params would otherwise issue thousands of writes. Batching packs all
+raw-serialized arrays smaller than the slab threshold into ``batched/<uuid>``
+slab objects and relocates their entries via ``byte_range``.
+
+Key TPU-first simplification over the reference: every raw-serialized
+array's byte size is computable from (shape, dtype) at *planning* time, so
+slab layout (member offsets) is decided before any data is staged — no
+two-phase relocation pass is needed. The read side merges adjacent byte
+ranges of the same object into single ranged reads.
+
+Gated off by default behind ``knobs.is_batching_enabled()`` (reference
+``knobs.py:53-57``; enable with ``TORCHSNAPSHOT_TPU_ENABLE_BATCHING=1``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from concurrent.futures import Executor
+from typing import Dict, List, Optional, Tuple
+
+from .io_types import (
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    ReadReq,
+    WriteReq,
+)
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    ShardedArrayEntry,
+)
+from .serialization import Serializer, array_nbytes
+from .utils import knobs
+
+
+def _collect_array_entries(entries: List[Entry]) -> Dict[str, ArrayEntry]:
+    """location -> ArrayEntry for every array entry, incl. nested ones."""
+    out: Dict[str, ArrayEntry] = {}
+    for entry in entries:
+        if isinstance(entry, ArrayEntry):
+            out[entry.location] = entry
+        elif isinstance(entry, ChunkedArrayEntry):
+            for chunk in entry.chunks:
+                out[chunk.tensor.location] = chunk.tensor
+        elif isinstance(entry, ShardedArrayEntry):
+            for shard in entry.shards:
+                out[shard.tensor.location] = shard.tensor
+    return out
+
+
+class BatchedBufferStager(BufferStager):
+    """Stages all members of one slab and concatenates their bytes."""
+
+    def __init__(self, members: List[Tuple[WriteReq, int, int]]) -> None:
+        # (orig write req, begin offset, end offset) — offsets precomputed.
+        self.members = members
+        self.total = members[-1][2] if members else 0
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        slab = bytearray(self.total)
+
+        async def stage_one(req: WriteReq, begin: int, end: int) -> None:
+            buf = await req.buffer_stager.stage_buffer(executor)
+            mv = memoryview(buf)
+            if mv.nbytes != end - begin:
+                raise RuntimeError(
+                    f"Staged size {mv.nbytes} != planned slab slot "
+                    f"{end - begin} for {req.path}"
+                )
+            slab[begin:end] = mv
+
+        await asyncio.gather(*(stage_one(*m) for m in self.members))
+        return slab
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.total
+
+
+def batch_write_requests(
+    entries: List[Entry], write_reqs: List[WriteReq]
+) -> Tuple[List[Entry], List[WriteReq]]:
+    """Coalesce small raw-array writes into slabs.
+
+    Mutates the affected :class:`ArrayEntry` objects in place (new
+    ``location`` + ``byte_range``), which is safe because it runs before the
+    manifest is gathered/serialized.
+    """
+    threshold = knobs.get_slab_size_threshold_bytes()
+    by_location = _collect_array_entries(entries)
+
+    small: List[Tuple[WriteReq, ArrayEntry, int]] = []
+    passthrough: List[WriteReq] = []
+    for req in write_reqs:
+        entry = by_location.get(req.path)
+        if entry is None or entry.serializer != Serializer.RAW:
+            passthrough.append(req)
+            continue
+        nbytes = array_nbytes(entry.shape, entry.dtype)
+        if nbytes >= threshold:
+            passthrough.append(req)
+        else:
+            small.append((req, entry, nbytes))
+
+    if len(small) <= 1:
+        return entries, write_reqs
+
+    # Deterministic packing order; slabs close at the threshold.
+    small.sort(key=lambda t: t[0].path)
+    batched_reqs: List[WriteReq] = []
+    slab: List[Tuple[WriteReq, int, int]] = []
+    slab_entries: List[ArrayEntry] = []
+    offset = 0
+
+    def close_slab() -> None:
+        nonlocal slab, slab_entries, offset
+        if not slab:
+            return
+        slab_path = f"batched/{uuid.uuid4().hex}"
+        for (req, begin, end), entry in zip(slab, slab_entries):
+            entry.location = slab_path
+            entry.byte_range = [begin, end]
+        batched_reqs.append(
+            WriteReq(path=slab_path, buffer_stager=BatchedBufferStager(slab))
+        )
+        slab, slab_entries, offset = [], [], 0
+
+    for req, entry, nbytes in small:
+        if offset + nbytes > threshold and slab:
+            close_slab()
+        slab.append((req, offset, offset + nbytes))
+        slab_entries.append(entry)
+        offset += nbytes
+    close_slab()
+
+    return entries, passthrough + batched_reqs
+
+
+# ---------------------------------------------------------------------------
+# Read-side: merge adjacent ranged reads of the same object
+# ---------------------------------------------------------------------------
+
+class BatchedBufferConsumer(BufferConsumer):
+    """Fans one merged buffer out to the member consumers by sub-range."""
+
+    def __init__(self, members: List[Tuple[ReadReq, int, int]]) -> None:
+        self.members = members  # (orig req, begin-in-buffer, end-in-buffer)
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        mv = memoryview(buf)
+        await asyncio.gather(
+            *(
+                req.buffer_consumer.consume_buffer(mv[begin:end], executor)
+                for req, begin, end in self.members
+            )
+        )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return sum(
+            req.buffer_consumer.get_consuming_cost_bytes()
+            for req, _, _ in self.members
+        )
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    """Merge exactly-adjacent byte-range reads per object into single reads."""
+    ranged: Dict[str, List[ReadReq]] = {}
+    passthrough: List[ReadReq] = []
+    for req in read_reqs:
+        if req.byte_range is None:
+            passthrough.append(req)
+        else:
+            ranged.setdefault(req.path, []).append(req)
+
+    out: List[ReadReq] = list(passthrough)
+    for path, reqs in ranged.items():
+        reqs.sort(key=lambda r: r.byte_range[0])
+        run: List[ReadReq] = []
+
+        def close_run() -> None:
+            if not run:
+                return
+            if len(run) == 1:
+                out.append(run[0])
+                return
+            begin = run[0].byte_range[0]
+            end = run[-1].byte_range[1]
+            members = [
+                (r, r.byte_range[0] - begin, r.byte_range[1] - begin) for r in run
+            ]
+            out.append(
+                ReadReq(
+                    path=path,
+                    buffer_consumer=BatchedBufferConsumer(members),
+                    byte_range=(begin, end),
+                )
+            )
+
+        for req in reqs:
+            if run and req.byte_range[0] != run[-1].byte_range[1]:
+                close_run()
+                run = []
+            run.append(req)
+        close_run()
+    return out

@@ -1,0 +1,127 @@
+"""Reversible flattening of nested state into flat logical paths.
+
+TPU-native analogue of the reference's ``flatten.py``
+(``/root/reference/torchsnapshot/flatten.py:18-215``). State dicts produced by
+``Stateful.state_dict()`` are nested ``dict``/``OrderedDict``/``list``
+containers whose leaves are arrays, primitives, or arbitrary objects. We map
+each leaf to a ``/``-separated logical path, recording container entries in a
+manifest so :func:`inflate` can rebuild the exact original structure.
+
+Escaping follows the reference's RFC-3986 style: ``%`` -> ``%25`` and ``/`` ->
+``%2F`` in key components. Dicts whose keys are not all ``str``/``int``, or
+whose keys collide after stringification (e.g. ``1`` vs ``"1"``), are kept as
+opaque leaves (pickled whole) rather than descended into (reference
+``flatten.py:142-154``).
+
+Note on pytrees: flax/optax states are plain nested dicts, so this covers them
+natively. Arbitrary pytrees can be checkpointed via
+``jax.tree_util.tree_flatten_with_path`` adapters at the ``Stateful`` layer;
+the on-disk logical-path format stays identical.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Tuple, Union
+
+from .manifest import (
+    DictEntry,
+    Entry,
+    ListEntry,
+    Manifest,
+    OrderedDictEntry,
+)
+
+
+def encode_component(key: Union[str, int]) -> str:
+    s = str(key)
+    return s.replace("%", "%25").replace("/", "%2F")
+
+
+def decode_component(s: str) -> str:
+    return s.replace("%2F", "/").replace("%25", "%")
+
+
+def _dict_is_flattenable(d: Dict[Any, Any]) -> bool:
+    seen = set()
+    for k in d.keys():
+        if not isinstance(k, (str, int)) or isinstance(k, bool):
+            return False
+        s = str(k)
+        if s in seen:
+            return False  # e.g. 1 vs "1" collide after stringification
+        seen.add(s)
+    return True
+
+
+def flatten(obj: Any, prefix: str = "") -> Tuple[Manifest, Dict[str, Any]]:
+    """Flatten ``obj`` into (container manifest, {logical_path: leaf})."""
+    manifest: Manifest = {}
+    flattened: Dict[str, Any] = {}
+    _flatten_inner(obj, manifest, flattened, prefix)
+    return manifest, flattened
+
+
+def _join(prefix: str, component: str) -> str:
+    return f"{prefix}/{component}" if prefix else component
+
+
+def _flatten_inner(
+    obj: Any, manifest: Manifest, flattened: Dict[str, Any], prefix: str
+) -> None:
+    if isinstance(obj, OrderedDict) and _dict_is_flattenable(obj):
+        manifest[prefix] = OrderedDictEntry(keys=list(obj.keys()))
+        for k, v in obj.items():
+            _flatten_inner(v, manifest, flattened, _join(prefix, encode_component(k)))
+    elif isinstance(obj, dict) and _dict_is_flattenable(obj):
+        manifest[prefix] = DictEntry(keys=list(obj.keys()))
+        for k, v in obj.items():
+            _flatten_inner(v, manifest, flattened, _join(prefix, encode_component(k)))
+    elif isinstance(obj, list):
+        manifest[prefix] = ListEntry()
+        for i, v in enumerate(obj):
+            _flatten_inner(v, manifest, flattened, _join(prefix, str(i)))
+    else:
+        flattened[prefix] = obj
+
+
+def inflate(
+    manifest: Manifest, flattened: Dict[str, Any], prefix: str = ""
+) -> Any:
+    """Rebuild the nested object flattened under ``prefix``.
+
+    ``manifest`` holds the container entries; ``flattened`` maps logical paths
+    to restored leaf values.
+    """
+    # Index children of each container path for single-pass reconstruction.
+    container_paths = {
+        p: e for p, e in manifest.items() if e.type in ("list", "dict", "ordered_dict")
+    }
+
+    def build(path: str) -> Any:
+        entry = container_paths.get(path)
+        if entry is None:
+            return flattened[path]
+        if isinstance(entry, ListEntry):
+            items: List[Any] = []
+            i = 0
+            while True:
+                child = _join(path, str(i))
+                if child in container_paths or child in flattened:
+                    items.append(build(child))
+                    i += 1
+                else:
+                    break
+            return items
+        if isinstance(entry, (DictEntry, OrderedDictEntry)):
+            out: Dict[Any, Any] = (
+                OrderedDict() if isinstance(entry, OrderedDictEntry) else {}
+            )
+            for k in entry.keys:
+                child = _join(path, encode_component(k))
+                if child in container_paths or child in flattened:
+                    out[k] = build(child)
+            return out
+        raise TypeError(f"Unexpected container entry {entry}")
+
+    return build(prefix)

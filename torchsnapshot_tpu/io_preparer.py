@@ -1,0 +1,133 @@
+"""Value -> (Entry, WriteReqs/ReadReqs) dispatch.
+
+TPU-native analogue of the reference's ``io_preparer.py:51-178``, with the
+routing redesigned around ``jax.Array``'s sharding metadata instead of
+torch's type taxonomy:
+
+- primitives -> inline :class:`PrimitiveEntry`;
+- ``jax.Array`` **fully replicated across every process** -> the replicated
+  array path (saved once globally, write load split by the partitioner).
+  This replaces the reference's DDP-module sniffing
+  (``snapshot.py:828-844``): on TPU, replication is *read off the sharding*,
+  no user globs required;
+- ``jax.Array`` on exactly one local device -> per-rank array path;
+- any other ``jax.Array`` (sharded / partially replicated) -> the sharded
+  path (elastic by construction);
+- ``np.ndarray`` -> array path (replicated only via user glob);
+- anything else -> pickled object.
+
+Arrays whose serialized size exceeds the chunking knob are split into dim-0
+chunks for transfer/I-O pipelining.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .io_types import WriteReq
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    Manifest,
+    ObjectEntry,
+    PrimitiveEntry,
+    PRIMITIVE_TYPES,
+)
+from .io_preparers.array import ArrayIOPreparer
+from .io_preparers.chunked_array import ChunkedArrayIOPreparer, should_chunk
+from .io_preparers.object import ObjectIOPreparer
+from .io_preparers.sharded_array import ShardedArrayIOPreparer
+
+
+def get_storage_path(logical_path: str, rank: int, replicated: bool) -> str:
+    """Reference ``io_preparer.py:51-57`` (``sharded/`` handled separately)."""
+    return f"replicated/{logical_path}" if replicated else f"{rank}/{logical_path}"
+
+
+def _is_jax_array(obj: Any) -> bool:
+    import jax
+
+    return isinstance(obj, jax.Array)
+
+
+def _globally_replicated(arr: Any, world_size: int) -> bool:
+    sharding = arr.sharding
+    if not sharding.is_fully_replicated:
+        return False
+    procs = {d.process_index for d in sharding.device_set}
+    return len(procs) == world_size and world_size > 1
+
+
+def classify(value: Any, world_size: int) -> str:
+    """One of: primitive | sharded | replicated_array | array | object."""
+    if isinstance(value, PRIMITIVE_TYPES) and not isinstance(value, np.generic):
+        return "primitive"
+    if _is_jax_array(value):
+        if _globally_replicated(value, world_size):
+            return "replicated_array"
+        if len(value.sharding.device_set) == 1:
+            return "array"
+        return "sharded"
+    if isinstance(value, np.ndarray):
+        return "array"
+    return "object"
+
+
+def prepare_write(
+    flattened: Dict[str, Any],
+    rank: int,
+    world_size: int,
+    replicated_paths: Set[str],
+    is_async_snapshot: bool = False,
+) -> Tuple[Manifest, List[WriteReq]]:
+    """Plan all writes for this rank's flattened state (no data moves yet)."""
+    manifest: Manifest = {}
+    write_reqs: List[WriteReq] = []
+    for logical_path, value in flattened.items():
+        kind = classify(value, world_size)
+        glob_replicated = logical_path in replicated_paths
+
+        if kind == "primitive":
+            manifest[logical_path] = PrimitiveEntry.from_value(
+                value, replicated=glob_replicated
+            )
+            continue
+
+        if kind == "sharded":
+            entry, reqs = ShardedArrayIOPreparer.prepare_write(
+                logical_path, value, is_async_snapshot=is_async_snapshot
+            )
+            manifest[logical_path] = entry
+            write_reqs.extend(reqs)
+            continue
+
+        if kind in ("replicated_array", "array"):
+            replicated = kind == "replicated_array" or glob_replicated
+            arr = value
+            if _is_jax_array(arr) and len(arr.sharding.device_set) > 1:
+                # Fully-replicated multi-device array: stage from the local copy.
+                arr = arr.addressable_shards[0].data
+            storage_path = get_storage_path(logical_path, rank, replicated)
+            if should_chunk(arr):
+                entry, reqs = ChunkedArrayIOPreparer.prepare_write(
+                    storage_path, arr, replicated, is_async_snapshot
+                )
+            else:
+                entry, reqs = ArrayIOPreparer.prepare_write(
+                    storage_path, arr, replicated, is_async_snapshot
+                )
+            manifest[logical_path] = entry
+            write_reqs.extend(reqs)
+            continue
+
+        # object fallback
+        storage_path = get_storage_path(logical_path, rank, glob_replicated)
+        entry, reqs = ObjectIOPreparer.prepare_write(
+            storage_path, value, replicated=glob_replicated
+        )
+        manifest[logical_path] = entry
+        write_reqs.extend(reqs)
+    return manifest, write_reqs

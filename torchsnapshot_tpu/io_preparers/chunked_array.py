@@ -1,0 +1,97 @@
+"""Dim-0 chunking of large arrays (reference ``io_preparers/chunked_tensor.py:34-126``).
+
+Splitting a big array into independent write requests lets the scheduler
+pipeline its D2H transfer with storage I/O *within* one array, and lets the
+partitioner split a replicated array's write load across processes at chunk
+granularity. On TPU the per-chunk slice ``arr[r0:r1]`` is an XLA device op, so
+chunk transfers stream out of HBM back-to-back without a full host-side copy
+first.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..io_types import ReadReq, WriteReq
+from ..manifest import ArrayEntry, ChunkedArrayEntry, Shard
+from ..serialization import array_nbytes
+from ..utils import knobs
+from .array import ArrayIOPreparer
+
+
+def should_chunk(arr: Any) -> bool:
+    nbytes = int(np.prod(arr.shape)) * np.dtype(arr.dtype).itemsize if arr.shape else 0
+    return (
+        len(arr.shape) >= 1
+        and arr.shape[0] > 1
+        and nbytes > knobs.get_max_chunk_size_bytes()
+    )
+
+
+def chunk_row_ranges(shape, itemsize: int, max_chunk_bytes: int) -> List[Tuple[int, int]]:
+    """Row ranges [r0, r1) per chunk, each chunk <= max_chunk_bytes (when a
+    single row fits)."""
+    dim0 = int(shape[0])
+    row_bytes = itemsize * int(np.prod(shape[1:])) if len(shape) > 1 else itemsize
+    rows_per_chunk = max(1, max_chunk_bytes // max(row_bytes, 1))
+    n_chunks = math.ceil(dim0 / rows_per_chunk)
+    # Even spread so the last chunk isn't tiny.
+    base = dim0 // n_chunks
+    extra = dim0 % n_chunks
+    ranges = []
+    r0 = 0
+    for i in range(n_chunks):
+        rows = base + (1 if i < extra else 0)
+        ranges.append((r0, r0 + rows))
+        r0 += rows
+    return ranges
+
+
+class ChunkedArrayIOPreparer:
+    @staticmethod
+    def prepare_write(
+        storage_path: str,
+        arr: Any,
+        replicated: bool = False,
+        is_async_snapshot: bool = False,
+    ) -> Tuple[ChunkedArrayEntry, List[WriteReq]]:
+        dtype = np.dtype(arr.dtype)
+        shape = list(arr.shape)
+        ranges = chunk_row_ranges(shape, dtype.itemsize, knobs.get_max_chunk_size_bytes())
+        chunks: List[Shard] = []
+        write_reqs: List[WriteReq] = []
+        for r0, r1 in ranges:
+            chunk_path = f"{storage_path}.chunk_{r0}"
+            sub_entry, sub_reqs = ArrayIOPreparer.prepare_write(
+                storage_path=chunk_path,
+                arr=arr[r0:r1],
+                replicated=replicated,
+                is_async_snapshot=is_async_snapshot,
+            )
+            offsets = [r0] + [0] * (len(shape) - 1)
+            sizes = [r1 - r0] + shape[1:]
+            chunks.append(Shard(offsets=offsets, sizes=sizes, tensor=sub_entry))
+            write_reqs.extend(sub_reqs)
+        entry = ChunkedArrayEntry(
+            dtype=chunks[0].tensor.dtype, shape=shape, chunks=chunks, replicated=replicated
+        )
+        return entry, write_reqs
+
+    @staticmethod
+    def prepare_read(
+        entry: ChunkedArrayEntry,
+        target: np.ndarray,
+        buffer_size_limit_bytes: Optional[int] = None,
+    ) -> List[ReadReq]:
+        read_reqs: List[ReadReq] = []
+        for chunk in entry.chunks:
+            r0 = chunk.offsets[0]
+            r1 = r0 + chunk.sizes[0]
+            view = target[r0:r1]
+            read_reqs.extend(
+                ArrayIOPreparer.prepare_read(chunk.tensor, view, buffer_size_limit_bytes)
+            )
+        return read_reqs

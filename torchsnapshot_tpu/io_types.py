@@ -1,0 +1,128 @@
+"""Core contracts tying planning to execution to storage.
+
+TPU-native analogue of the reference's ``io_types.py`` (see
+``/root/reference/torchsnapshot/io_types.py:19-103``): the planning layer turns
+application state into :class:`WriteReq`/:class:`ReadReq` lists, the scheduler
+executes them against a :class:`StoragePlugin`, and buffers flow through the
+:class:`BufferStager`/:class:`BufferConsumer` protocols so that device-to-host
+transfer, serialization, and storage I/O can be pipelined without ever
+materializing more than a memory budget's worth of data.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import io
+from concurrent.futures import Executor
+from dataclasses import dataclass, field
+from typing import Optional, Tuple, Union
+
+# A staged buffer is either raw bytes or a zero-copy view over host memory.
+BufferType = Union[bytes, bytearray, memoryview]
+
+
+class BufferStager(abc.ABC):
+    """Produces the bytes for one write request, as lazily as possible.
+
+    ``stage_buffer`` performs the expensive part (device-to-host transfer +
+    serialization). It is invoked by the scheduler only when the memory budget
+    admits the request, and runs its blocking portions on ``executor``.
+    """
+
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        ...
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Estimated peak host memory consumed by :meth:`stage_buffer`."""
+        ...
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+class BufferConsumer(abc.ABC):
+    """Consumes the bytes of one read request (deserialize + copy into place)."""
+
+    @abc.abstractmethod
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        """Estimated peak host memory consumed by :meth:`consume_buffer`."""
+        ...
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[Tuple[int, int]] = None  # [begin, end)
+
+
+@dataclass
+class WriteIO:
+    path: str
+    buf: BufferType
+
+
+@dataclass
+class ReadIO:
+    path: str
+    byte_range: Optional[Tuple[int, int]] = None
+    buf: io.BytesIO = field(default_factory=io.BytesIO)
+
+
+class StoragePlugin(abc.ABC):
+    """Async storage backend contract (reference ``io_types.py:67-103``).
+
+    Implementations must be safe for many concurrent in-flight operations on
+    one event loop. Ranged reads (``ReadIO.byte_range``) enable random access
+    into cloud-resident snapshots without fetching whole objects.
+    """
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None:
+        ...
+
+    async def close(self) -> None:
+        pass
+
+    # -- sync conveniences driving a caller-owned event loop -----------------
+    def sync_write(
+        self, write_io: WriteIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.write(write_io), event_loop)
+
+    def sync_read(
+        self, read_io: ReadIO, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.read(read_io), event_loop)
+
+    def sync_close(
+        self, event_loop: Optional[asyncio.AbstractEventLoop] = None
+    ) -> None:
+        _run(self.close(), event_loop)
+
+
+def _run(coro, event_loop: Optional[asyncio.AbstractEventLoop]) -> None:
+    if event_loop is not None:
+        event_loop.run_until_complete(coro)
+    else:
+        asyncio.new_event_loop().run_until_complete(coro)

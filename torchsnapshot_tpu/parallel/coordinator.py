@@ -1,0 +1,168 @@
+"""Process-group abstraction for the checkpoint control plane.
+
+TPU-native analogue of the reference's ``pg_wrapper.py:15-89``, with one
+deliberate design change: the reference runs small-object collectives
+(``all_gather_object``, ``broadcast_object_list``, ``barrier``) over
+gloo/nccl, but on TPU every XLA collective occupies the accelerator stream
+and must run on the main thread. Checkpoint planning traffic is tiny
+(manifests, globs, load sizes), so the coordinator runs it over the KV store
+instead — jax's coordination service on a pod (already up whenever
+``jax.distributed.initialize`` ran), or our :class:`TCPStore` elsewhere. Bulk
+array data never moves between processes at all: each process streams its
+partition straight to storage (reference design, ``SURVEY.md`` §2.2).
+
+Generation counters make every collective use a fresh key namespace, so the
+store needs no cleanup-synchronization between consecutive collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+from .store import (
+    JaxCoordinationStore,
+    LocalStore,
+    Store,
+    TCPStore,
+)
+
+_ENV_STORE_ADDR = "TORCHSNAPSHOT_TPU_STORE_ADDR"  # host:port of a TCPStore
+_ENV_RANK = "TORCHSNAPSHOT_TPU_RANK"
+_ENV_WORLD_SIZE = "TORCHSNAPSHOT_TPU_WORLD_SIZE"
+
+
+def _resolve_timeout(timeout_s: Optional[float]) -> float:
+    """Default collective timeout, raisable via the barrier-timeout knob
+    (commit barriers legitimately wait out the slowest rank's data write)."""
+    from ..utils import knobs
+
+    return timeout_s if timeout_s is not None else knobs.get_barrier_timeout_s()
+
+
+class Coordinator:
+    """Rank/world-size + object collectives over a :class:`Store`."""
+
+    def __init__(self, store: Store, rank: int, world_size: int) -> None:
+        self._store = store
+        self._rank = rank
+        self._world_size = world_size
+        self._generation = 0
+
+    # -- identity -----------------------------------------------------------
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def store(self) -> Store:
+        return self._store
+
+    def _next_ns(self, op: str) -> Store:
+        self._generation += 1
+        return self._store.prefix(f"coll/{op}/{self._generation}")
+
+    # -- collectives --------------------------------------------------------
+    def barrier(self, timeout_s: Optional[float] = None) -> None:
+        if self._world_size == 1:
+            return
+        timeout_s = _resolve_timeout(timeout_s)
+        ns = self._next_ns("barrier")
+        count = ns.add("count", 1)
+        if count == self._world_size:
+            ns.set("done", b"1")
+        ns.get("done", timeout_s=timeout_s)
+
+    def all_gather_object(
+        self, obj: Any, timeout_s: Optional[float] = None
+    ) -> List[Any]:
+        if self._world_size == 1:
+            return [obj]
+        timeout_s = _resolve_timeout(timeout_s)
+        ns = self._next_ns("all_gather")
+        ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        return [
+            pickle.loads(ns.get(str(r), timeout_s=timeout_s))
+            for r in range(self._world_size)
+        ]
+
+    def broadcast_object(
+        self, obj: Any, src: int = 0, timeout_s: Optional[float] = None
+    ) -> Any:
+        if self._world_size == 1:
+            return obj
+        timeout_s = _resolve_timeout(timeout_s)
+        ns = self._next_ns("broadcast")
+        if self._rank == src:
+            ns.set("obj", pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+            return obj
+        return pickle.loads(ns.get("obj", timeout_s=timeout_s))
+
+    def gather_object(
+        self, obj: Any, dst: int = 0, timeout_s: Optional[float] = None
+    ) -> Optional[List[Any]]:
+        if self._world_size == 1:
+            return [obj]
+        timeout_s = _resolve_timeout(timeout_s)
+        ns = self._next_ns("gather")
+        ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        if self._rank != dst:
+            return None
+        return [
+            pickle.loads(ns.get(str(r), timeout_s=timeout_s))
+            for r in range(self._world_size)
+        ]
+
+    def scatter_object(
+        self, objs: Optional[List[Any]], src: int = 0, timeout_s: Optional[float] = None
+    ) -> Any:
+        if self._world_size == 1:
+            assert objs is not None
+            return objs[0]
+        timeout_s = _resolve_timeout(timeout_s)
+        ns = self._next_ns("scatter")
+        if self._rank == src:
+            assert objs is not None and len(objs) == self._world_size
+            for r, o in enumerate(objs):
+                ns.set(str(r), pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL))
+        return pickle.loads(ns.get(str(self._rank), timeout_s=timeout_s))
+
+
+# One coordinator per process: collective generation counters must advance in
+# lockstep across ranks, which holds when every rank issues the same SPMD
+# sequence of collectives against a single long-lived coordinator.
+_CACHED: Optional[Coordinator] = None
+
+
+def get_coordinator(coordinator: Optional[Coordinator] = None) -> Coordinator:
+    """Resolve the active coordinator (reference ``PGWrapper.__init__``).
+
+    Order: explicit argument > jax.distributed coordination service >
+    env-var-configured TCPStore > single process.
+    """
+    global _CACHED
+    if coordinator is not None:
+        return coordinator
+    if _CACHED is not None:
+        return _CACHED
+
+    if JaxCoordinationStore.available():
+        import jax
+
+        _CACHED = Coordinator(
+            JaxCoordinationStore(), jax.process_index(), jax.process_count()
+        )
+    else:
+        addr = os.environ.get(_ENV_STORE_ADDR)
+        if addr:
+            rank = int(os.environ[_ENV_RANK])
+            world_size = int(os.environ[_ENV_WORLD_SIZE])
+            host, _, port = addr.rpartition(":")
+            store = TCPStore(host, int(port), is_server=(rank == 0))
+            _CACHED = Coordinator(store, rank, world_size)
+        else:
+            _CACHED = Coordinator(LocalStore(), 0, 1)
+    return _CACHED

@@ -1,0 +1,393 @@
+"""Key-value stores + a thread-safe two-phase barrier.
+
+TPU-native analogue of the reference's ``dist_store.py:22-196``. The reference
+needs a TCPStore because c10d collectives can't run off the main thread; JAX
+has the same constraint (collectives are XLA computations on the main thread),
+so the async-snapshot commit barrier runs over a KV store instead:
+
+- :class:`JaxCoordinationStore` rides the jax.distributed coordination
+  service (gRPC, callable from any thread) — zero extra infrastructure on a
+  TPU pod, where `jax.distributed.initialize` is already required.
+- :class:`TCPStore` is a small self-contained socket store for runs without
+  jax.distributed (e.g. torch-free multi-process CPU tests, custom pods). The
+  server lives in the rank-0 process; every op is a framed pickle message.
+
+:class:`LinearBarrier` is the reference's two-phase (arrive/depart) barrier
+with leader-held critical section and cross-rank error propagation
+(``dist_store.py:91-196``): if any rank reports an error, every other rank
+raises instead of deadlocking, and the leader never commits.
+"""
+
+from __future__ import annotations
+
+import abc
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_TIMEOUT_S = 300.0
+
+
+class Store(abc.ABC):
+    """Minimal KV contract needed by the coordinator and LinearBarrier."""
+
+    @abc.abstractmethod
+    def set(self, key: str, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        """Blocking get: waits until ``key`` exists."""
+        ...
+
+    @abc.abstractmethod
+    def try_get(self, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def add(self, key: str, delta: int) -> int:
+        """Atomic increment; returns the new value (missing key counts as 0)."""
+        ...
+
+    def prefix(self, p: str) -> "PrefixStore":
+        return PrefixStore(p, self)
+
+
+class PrefixStore(Store):
+    def __init__(self, prefix: str, store: Store) -> None:
+        self._prefix = prefix
+        self._store = store
+
+    def set(self, key: str, value: bytes) -> None:
+        self._store.set(f"{self._prefix}/{key}", value)
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        return self._store.get(f"{self._prefix}/{key}", timeout_s)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._store.try_get(f"{self._prefix}/{key}")
+
+    def add(self, key: str, delta: int) -> int:
+        return self._store.add(f"{self._prefix}/{key}", delta)
+
+
+# ---------------------------------------------------------------------------
+# In-process store (single-process runs and unit tests)
+# ---------------------------------------------------------------------------
+
+class LocalStore(Store):
+    def __init__(self) -> None:
+        self._data: Dict[str, bytes] = {}
+        self._counters: Dict[str, int] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise TimeoutError(f"Store.get timed out waiting for {key!r}")
+            return self._data[key]
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        with self._cond:
+            return self._data.get(key)
+
+    def add(self, key: str, delta: int) -> int:
+        with self._cond:
+            self._counters[key] = self._counters.get(key, 0) + delta
+            self._cond.notify_all()
+            return self._counters[key]
+
+
+# ---------------------------------------------------------------------------
+# jax coordination-service-backed store
+# ---------------------------------------------------------------------------
+
+class JaxCoordinationStore(Store):
+    """Rides ``jax.distributed``'s coordination service (usable off-thread)."""
+
+    def __init__(self, namespace: str = "tss") -> None:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized; "
+                "call jax.distributed.initialize() or provide a TCPStore"
+            )
+        self._client = client
+        self._ns = namespace
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            from jax._src import distributed
+
+            return distributed.global_state.client is not None
+        except Exception:
+            return False
+
+    def _k(self, key: str) -> str:
+        return f"{self._ns}/{key}"
+
+    def set(self, key: str, value: bytes) -> None:
+        self._client.key_value_set_bytes(self._k(key), bytes(value))
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        try:
+            return bytes(
+                self._client.blocking_key_value_get_bytes(
+                    self._k(key), int(timeout_s * 1000)
+                )
+            )
+        except Exception as e:
+            # jax surfaces coordination-service timeouts as XlaRuntimeError
+            # (DEADLINE_EXCEEDED); normalize so callers that poll with short
+            # timeouts (e.g. LinearBarrier) can catch TimeoutError uniformly.
+            msg = str(e)
+            if "DEADLINE" in msg or "deadline" in msg or "imed out" in msg:
+                raise TimeoutError(
+                    f"Store.get timed out waiting for {key!r}"
+                ) from e
+            raise
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            val = self._client.key_value_try_get_bytes(self._k(key))
+        except Exception:
+            return None
+        return bytes(val) if val is not None else None
+
+    def add(self, key: str, delta: int) -> int:
+        return int(self._client.key_value_increment(self._k(key), delta))
+
+
+# ---------------------------------------------------------------------------
+# Self-contained TCP store
+# ---------------------------------------------------------------------------
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (length,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class _StoreServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _StoreHandler)
+        self.data: Dict[str, bytes] = {}
+        self.counters: Dict[str, int] = {}
+        self.cond = threading.Condition()
+
+
+class _StoreHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: _StoreServer = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                op, key, arg = _recv_msg(self.request)
+                if op == "set":
+                    with server.cond:
+                        server.data[key] = arg
+                        server.cond.notify_all()
+                    _send_msg(self.request, ("ok", None))
+                elif op == "get":
+                    deadline = time.monotonic() + arg
+                    with server.cond:
+                        while key not in server.data:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0 or not server.cond.wait(
+                                min(remaining, 1.0)
+                            ):
+                                if time.monotonic() >= deadline:
+                                    break
+                        val = server.data.get(key)
+                    if val is None:
+                        _send_msg(self.request, ("timeout", None))
+                    else:
+                        _send_msg(self.request, ("ok", val))
+                elif op == "try_get":
+                    with server.cond:
+                        val = server.data.get(key)
+                    _send_msg(self.request, ("ok", val))
+                elif op == "add":
+                    with server.cond:
+                        server.counters[key] = server.counters.get(key, 0) + arg
+                        val = server.counters[key]
+                        server.cond.notify_all()
+                    _send_msg(self.request, ("ok", val))
+                else:
+                    _send_msg(self.request, ("err", f"unknown op {op}"))
+        except (ConnectionError, EOFError):
+            pass
+
+
+class TCPStore(Store):
+    """Socket KV store; the server thread lives in the host process of rank 0."""
+
+    def __init__(self, host: str, port: int, is_server: bool) -> None:
+        self.host = host
+        self.port = port
+        self._server: Optional[_StoreServer] = None
+        if is_server:
+            self._server = _StoreServer((host, port))
+            if port == 0:
+                self.port = self._server.server_address[1]
+            threading.Thread(
+                target=self._server.serve_forever, daemon=True
+            ).start()
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            deadline = time.monotonic() + 60
+            last_err: Optional[Exception] = None
+            while time.monotonic() < deadline:
+                try:
+                    sock = socket.create_connection((self.host, self.port), timeout=600)
+                    break
+                except OSError as e:
+                    last_err = e
+                    time.sleep(0.1)
+            else:
+                raise ConnectionError(f"cannot reach store: {last_err}")
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+        return sock
+
+    def _call(self, op: str, key: str, arg: Any) -> Any:
+        sock = self._sock()
+        _send_msg(sock, (op, key, arg))
+        status, val = _recv_msg(sock)
+        if status == "timeout":
+            raise TimeoutError(f"Store.get timed out waiting for {key!r}")
+        if status != "ok":
+            raise RuntimeError(val)
+        return val
+
+    def set(self, key: str, value: bytes) -> None:
+        self._call("set", key, bytes(value))
+
+    def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        return self._call("get", key, timeout_s)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._call("try_get", key, None)
+
+    def add(self, key: str, delta: int) -> int:
+        return self._call("add", key, delta)
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# LinearBarrier
+# ---------------------------------------------------------------------------
+
+class BarrierError(RuntimeError):
+    pass
+
+
+class LinearBarrier:
+    """Two-phase store barrier with leader critical section + error fan-out.
+
+    Usage (reference ``snapshot.py:948-969``)::
+
+        barrier = LinearBarrier(store, barrier_id, rank, world_size)
+        try:
+            barrier.arrive(timeout)     # all ranks' data is durable
+            if rank == 0:
+                commit_metadata()       # leader-only critical section
+            barrier.depart(timeout)
+        except Exception as e:
+            barrier.report_error(e)     # unblocks + fails all peers
+            raise
+    """
+
+    def __init__(self, store: Store, barrier_id: str, rank: int, world_size: int):
+        self._store = store.prefix(f"barrier/{barrier_id}")
+        self._rank = rank
+        self._world_size = world_size
+
+    def arrive(self, timeout_s: Optional[float] = None) -> None:
+        self._phase("arrive", self._resolve_timeout(timeout_s))
+
+    def depart(self, timeout_s: Optional[float] = None) -> None:
+        self._phase("depart", self._resolve_timeout(timeout_s))
+
+    @staticmethod
+    def _resolve_timeout(timeout_s: Optional[float]) -> float:
+        if timeout_s is not None:
+            return timeout_s
+        from ..utils import knobs
+
+        return knobs.get_barrier_timeout_s()
+
+    def _phase(self, phase: str, timeout_s: float) -> None:
+        count = self._store.add(phase, 1)
+        if count == self._world_size:
+            self._store.set(f"{phase}/done", b"1")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            err = self._store.try_get("error")
+            if err is not None:
+                rank, msg = pickle.loads(err)
+                raise BarrierError(f"rank {rank} failed: {msg}")
+            try:
+                self._store.get(f"{phase}/done", timeout_s=1.0)
+                # report_error() force-sets the done keys to unblock waiters,
+                # so re-check for a peer failure before declaring success.
+                err = self._store.try_get("error")
+                if err is not None:
+                    rank, msg = pickle.loads(err)
+                    raise BarrierError(f"rank {rank} failed: {msg}")
+                return
+            except TimeoutError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"LinearBarrier {phase} timed out "
+                        f"({count}/{self._world_size} arrived)"
+                    )
+
+    def report_error(self, e: Exception) -> None:
+        self._store.set("error", pickle.dumps((self._rank, repr(e))))
+        # Unblock peers waiting on phase-done keys; they'll see the error.
+        self._store.set("arrive/done", b"1")
+        self._store.set("depart/done", b"1")

@@ -1,0 +1,113 @@
+"""Replicated-write load balancing across processes.
+
+Analogue of the reference's ``partitioner.py:42-302``, redesigned to need
+**no broadcast**: the reference has rank 0 greedy-bin-pack and broadcast the
+assignment (``partitioner.py:126-145``); here every rank runs the identical
+deterministic greedy algorithm on identical inputs (one ``all_gather`` of
+per-rank non-replicated loads — integer byte counts, so there is no
+floating-point divergence risk), which saves a collective round-trip on the
+take() critical path.
+
+Replicated logical paths are globally identical by construction (their
+storage paths carry no rank), so each rank independently keeps exactly the
+write requests assigned to it. Chunked replicated arrays partition at chunk
+granularity (reference ``partitioner.py:31-39``). Every rank keeps all
+replicated *entries* in its manifest regardless of who writes the bytes —
+the per-rank manifest view is what makes them available to every rank on
+restore (reference ``consolidate_replicated_entries:259``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .io_types import WriteReq
+from .manifest import Entry, Manifest, is_replicated
+from .parallel.coordinator import Coordinator
+
+
+def _estimate(req: WriteReq) -> int:
+    return req.buffer_stager.get_staging_cost_bytes()
+
+
+def partition_write_reqs(
+    manifest: Manifest,
+    write_reqs: List[WriteReq],
+    coordinator: Coordinator,
+) -> List[WriteReq]:
+    """Return the subset of ``write_reqs`` this rank should execute."""
+    world_size = coordinator.get_world_size()
+    rank = coordinator.get_rank()
+    if world_size == 1:
+        return write_reqs
+
+    replicated_locations = set()
+    for entry in manifest.values():
+        if is_replicated(entry):
+            if hasattr(entry, "location"):
+                replicated_locations.add(entry.location)
+            if hasattr(entry, "chunks"):
+                for chunk in entry.chunks:
+                    replicated_locations.add(chunk.tensor.location)
+
+    replicated_reqs = [r for r in write_reqs if r.path in replicated_locations]
+    other_reqs = [r for r in write_reqs if r.path not in replicated_locations]
+
+    # Per-rank base load from non-replicated writes.
+    local_load = sum(_estimate(r) for r in other_reqs)
+    loads: List[int] = coordinator.all_gather_object(local_load)
+
+    # Deterministic greedy: biggest request first onto the least-loaded rank.
+    # Sort key includes the path so every rank breaks ties identically.
+    items: List[Tuple[int, str]] = sorted(
+        ((_estimate(r), r.path) for r in replicated_reqs),
+        key=lambda t: (-t[0], t[1]),
+    )
+    assignment: Dict[str, int] = {}
+    for size, path in items:
+        target = min(range(world_size), key=lambda r: (loads[r], r))
+        assignment[path] = target
+        loads[target] += size
+
+    return other_reqs + [r for r in replicated_reqs if assignment[r.path] == rank]
+
+
+def consolidate_replicated_entries(global_manifest: Manifest) -> None:
+    """Make every rank's copy of a replicated entry reflect the writer's.
+
+    Analogue of the reference's ``consolidate_replicated_entries:236-292``.
+    Post-partitioning transforms of write requests (currently: slab batching,
+    which relocates entries to ``batched/<uuid>`` with a ``byte_range``)
+    happen only on the rank that writes the bytes, so the other ranks'
+    manifest copies go stale. Entries are merged in place per logical path,
+    preferring relocated versions (chunk-by-chunk for chunked entries).
+    """
+    from .manifest import ArrayEntry, ChunkedArrayEntry
+
+    by_path: Dict[str, List[Entry]] = {}
+    for key, entry in global_manifest.items():
+        if is_replicated(entry):
+            _, _, path = key.partition("/")
+            by_path.setdefault(path, []).append(entry)
+
+    def relocated(e: ArrayEntry) -> bool:
+        return e.byte_range is not None
+
+    for entries in by_path.values():
+        if isinstance(entries[0], ArrayEntry):
+            chosen = next((e for e in entries if relocated(e)), entries[0])
+            for e in entries:
+                e.location = chosen.location
+                e.byte_range = chosen.byte_range
+        elif isinstance(entries[0], ChunkedArrayEntry):
+            # Chunks of one entry may have been written (and relocated) by
+            # different ranks; merge per chunk, keyed by offsets.
+            chosen_chunks: Dict[Tuple[int, ...], object] = {}
+            for e in entries:
+                for chunk in e.chunks:
+                    key = tuple(chunk.offsets)
+                    if key not in chosen_chunks or relocated(chunk.tensor):
+                        chosen_chunks[key] = chunk
+            for e in entries:
+                for i, chunk in enumerate(e.chunks):
+                    e.chunks[i] = chosen_chunks[tuple(chunk.offsets)]

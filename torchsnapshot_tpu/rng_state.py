@@ -1,0 +1,29 @@
+"""Host RNG capture (reference ``rng_state.py:13-38``).
+
+JAX device randomness is explicit (``jax.random`` keys are ordinary arrays in
+the app state, so they checkpoint like any other leaf). What still needs
+capturing is *host* randomness used by data pipelines: Python's ``random`` and
+NumPy's global generator. ``Snapshot`` treats ``RNGState`` specially to
+guarantee the take/restore determinism invariant: the RNG state a restore
+reinstates is the state as of the *beginning* of the take (see
+``snapshot.py`` ``_pop_rng_state``; reference ``snapshot.py:341-376``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+import numpy as np
+
+
+class RNGState:
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "python": random.getstate(),
+            "numpy": np.random.get_state(),
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        random.setstate(state_dict["python"])
+        np.random.set_state(state_dict["numpy"])

@@ -1,0 +1,294 @@
+"""Memory-budgeted async execution pipelines.
+
+Conceptual port of the reference's scheduler state machine
+(``/root/reference/torchsnapshot/scheduler.py:220-461``) — not of its code.
+
+Write pipeline stages::
+
+    ready_for_staging ──(budget admits)──> staging ──> ready_for_io ──> io ──> done
+                         D2H + serialize                 storage.write
+                         (thread pool)                   (async, <=16 in flight)
+
+The memory budget is debited by each request's estimated staging cost when it
+is admitted, corrected to the actual buffer size when staging completes, and
+credited back when its storage write completes. One over-budget request is
+always admitted when the pipeline is otherwise empty, so a single huge array
+can't deadlock the pipeline (reference ``scheduler.py:268``).
+
+``execute_write_reqs`` returns when **staging** completes — every byte is in
+host RAM — handing back a :class:`PendingIOWork` that drains the remaining
+storage I/O. This is the hinge that makes ``async_take`` overlap storage I/O
+with resumed training (reference ``scheduler.py:178-214``).
+
+The read pipeline mirrors it: storage reads are admitted under a consuming
+budget and buffers are handed to consumers (deserialize + scatter) on the
+thread pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+import psutil
+
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .utils import knobs
+
+logger = logging.getLogger(__name__)
+
+_MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
+_AVAILABLE_MEMORY_MULTIPLIER = 0.6
+_MAX_CONCURRENT_IO = 16
+_MAX_STAGING_THREADS = 4
+_MAX_CONSUMING_THREADS = 4
+
+
+def get_process_memory_budget_bytes(coordinator=None) -> int:
+    """Per-process staging budget (reference ``scheduler.py:27-65``)."""
+    override = knobs.get_memory_budget_override_bytes()
+    if override is not None:
+        return override
+    available = psutil.virtual_memory().available
+    local_world_size = 1
+    if coordinator is not None and coordinator.get_world_size() > 1:
+        hostnames = coordinator.all_gather_object(socket.gethostname())
+        local_world_size = max(1, hostnames.count(socket.gethostname()))
+    budget = int(available * _AVAILABLE_MEMORY_MULTIPLIER / local_world_size)
+    return min(budget, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
+
+
+class _Budget:
+    def __init__(self, total: int) -> None:
+        self.total = total
+        self.available = total
+
+    def debit(self, n: int) -> None:
+        self.available -= n
+
+    def credit(self, n: int) -> None:
+        self.available += n
+
+
+class PendingIOWork:
+    """Storage I/O still in flight after staging completed."""
+
+    def __init__(
+        self,
+        storage: StoragePlugin,
+        budget: _Budget,
+        ready_for_io: Deque[Tuple[str, object]],
+        io_tasks: Dict[asyncio.Task, int],
+        rank: int,
+        bytes_staged: int,
+        begin_ts: float,
+    ) -> None:
+        self._storage = storage
+        self._budget = budget
+        self._ready_for_io = ready_for_io
+        self._io_tasks = io_tasks
+        self._rank = rank
+        self._bytes_staged = bytes_staged
+        self._begin_ts = begin_ts
+
+    def _dispatch_io(self) -> None:
+        while self._ready_for_io and len(self._io_tasks) < _MAX_CONCURRENT_IO:
+            path, buf = self._ready_for_io.popleft()
+            nbytes = memoryview(buf).nbytes
+            task = asyncio.ensure_future(self._storage.write(WriteIO(path=path, buf=buf)))
+            self._io_tasks[task] = nbytes
+
+    async def complete(self) -> None:
+        self._dispatch_io()
+        while self._io_tasks:
+            done, _ = await asyncio.wait(
+                self._io_tasks.keys(), return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                nbytes = self._io_tasks.pop(task)
+                task.result()  # propagate failures
+                self._budget.credit(nbytes)
+            self._dispatch_io()
+        elapsed = time.monotonic() - self._begin_ts
+        if self._bytes_staged:
+            logger.info(
+                "Rank %d wrote %.2f GB in %.2fs (%.2f GB/s)",
+                self._rank,
+                self._bytes_staged / 1e9,
+                elapsed,
+                self._bytes_staged / 1e9 / max(elapsed, 1e-9),
+            )
+
+    def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
+        event_loop.run_until_complete(self.complete())
+
+
+async def execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> PendingIOWork:
+    begin_ts = time.monotonic()
+    budget = _Budget(memory_budget_bytes)
+    # Stage big requests first: they dominate the critical path and admit
+    # small ones into the leftover budget.
+    pending: Deque[WriteReq] = deque(
+        sorted(write_reqs, key=lambda r: -r.buffer_stager.get_staging_cost_bytes())
+    )
+    staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
+    ready_for_io: Deque[Tuple[str, object]] = deque()
+    io_tasks: Dict[asyncio.Task, int] = {}
+    bytes_staged = 0
+    executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
+
+    def dispatch_staging() -> None:
+        while pending:
+            cost = pending[0].buffer_stager.get_staging_cost_bytes()
+            over_budget = cost > budget.available
+            pipeline_empty = not staging_tasks and not io_tasks
+            if over_budget and not pipeline_empty:
+                break
+            req = pending.popleft()
+            budget.debit(cost)
+            task = asyncio.ensure_future(req.buffer_stager.stage_buffer(executor))
+            staging_tasks[task] = (req, cost)
+
+    def dispatch_io() -> None:
+        while ready_for_io and len(io_tasks) < _MAX_CONCURRENT_IO:
+            path, buf = ready_for_io.popleft()
+            nbytes = memoryview(buf).nbytes
+            task = asyncio.ensure_future(storage.write(WriteIO(path=path, buf=buf)))
+            io_tasks[task] = nbytes
+
+    try:
+        dispatch_staging()
+        while staging_tasks or pending:
+            done, _ = await asyncio.wait(
+                set(staging_tasks.keys()) | set(io_tasks.keys()),
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for task in done:
+                if task in staging_tasks:
+                    req, cost = staging_tasks.pop(task)
+                    buf = task.result()
+                    nbytes = memoryview(buf).nbytes
+                    bytes_staged += nbytes
+                    # Correct the estimate to the real footprint.
+                    budget.credit(cost)
+                    budget.debit(nbytes)
+                    ready_for_io.append((req.path, buf))
+                else:
+                    nbytes = io_tasks.pop(task)
+                    task.result()
+                    budget.credit(nbytes)
+            dispatch_io()
+            dispatch_staging()
+    finally:
+        executor.shutdown(wait=False)
+
+    elapsed = time.monotonic() - begin_ts
+    logger.info(
+        "Rank %d staged %.2f GB in %.2fs", rank, bytes_staged / 1e9, elapsed
+    )
+    return PendingIOWork(
+        storage, budget, ready_for_io, io_tasks, rank, bytes_staged, begin_ts
+    )
+
+
+def sync_execute_write_reqs(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> PendingIOWork:
+    return event_loop.run_until_complete(
+        execute_write_reqs(write_reqs, storage, memory_budget_bytes, rank)
+    )
+
+
+async def execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> None:
+    begin_ts = time.monotonic()
+    budget = _Budget(memory_budget_bytes)
+    pending: Deque[ReadReq] = deque(
+        sorted(read_reqs, key=lambda r: -r.buffer_consumer.get_consuming_cost_bytes())
+    )
+    io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int]] = {}
+    consume_tasks: Dict[asyncio.Task, int] = {}
+    bytes_read = 0
+    executor = ThreadPoolExecutor(max_workers=_MAX_CONSUMING_THREADS)
+
+    async def read_one(req: ReadReq) -> object:
+        read_io = ReadIO(path=req.path, byte_range=req.byte_range)
+        await storage.read(read_io)
+        return read_io.buf.getbuffer()
+
+    def dispatch_reads() -> None:
+        while pending and len(io_tasks) < _MAX_CONCURRENT_IO:
+            cost = pending[0].buffer_consumer.get_consuming_cost_bytes()
+            over_budget = cost > budget.available
+            pipeline_empty = not io_tasks and not consume_tasks
+            if over_budget and not pipeline_empty:
+                break
+            req = pending.popleft()
+            budget.debit(cost)
+            io_tasks[asyncio.ensure_future(read_one(req))] = (req, cost)
+
+    try:
+        dispatch_reads()
+        while io_tasks or consume_tasks or pending:
+            done, _ = await asyncio.wait(
+                set(io_tasks.keys()) | set(consume_tasks.keys()),
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for task in done:
+                if task in io_tasks:
+                    req, cost = io_tasks.pop(task)
+                    buf = task.result()
+                    bytes_read += memoryview(buf).nbytes
+                    consume_tasks[
+                        asyncio.ensure_future(
+                            req.buffer_consumer.consume_buffer(buf, executor)
+                        )
+                    ] = cost
+                else:
+                    cost = consume_tasks.pop(task)
+                    task.result()
+                    budget.credit(cost)
+            dispatch_reads()
+    finally:
+        executor.shutdown(wait=False)
+
+    elapsed = time.monotonic() - begin_ts
+    if bytes_read:
+        logger.info(
+            "Rank %d read %.2f GB in %.2fs (%.2f GB/s)",
+            rank,
+            bytes_read / 1e9,
+            elapsed,
+            bytes_read / 1e9 / max(elapsed, 1e-9),
+        )
+
+
+def sync_execute_read_reqs(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> None:
+    event_loop.run_until_complete(
+        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank)
+    )

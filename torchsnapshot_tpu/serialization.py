@@ -1,0 +1,134 @@
+"""Zero-copy array (de)serialization with explicit dtype tables.
+
+TPU-native analogue of the reference's ``serialization.py``
+(``/root/reference/torchsnapshot/serialization.py:32-256``). The reference
+round-trips ``torch.Tensor`` through the buffer protocol with a special path
+for bfloat16 (which numpy can't express); here every accelerator dtype —
+including bfloat16, the float8 variants, and int4 — is a first-class numpy
+dtype via ``ml_dtypes``, and the uniform zero-copy path is a ``uint8`` view of
+the contiguous array (plain ``memoryview(arr)`` raises for ml_dtypes custom
+dtypes, so we never use it).
+
+Two serializers exist:
+
+- ``raw``: little-endian C-contiguous raw bytes. Used for every dtype in
+  :data:`SUPPORTED_DTYPES`. Enables ranged reads (a byte range of the
+  serialized buffer corresponds to a contiguous region of the flat array).
+- ``pickle``: ``pickle`` of arbitrary Python objects. Fallback for
+  non-array leaves (reference used ``torch.save``; we have no torch
+  dependency on the TPU path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    ml_dtypes = None
+
+
+class Serializer:
+    RAW = "raw"
+    PICKLE = "pickle"
+
+
+def _build_dtype_table():
+    table = {
+        "bool": np.dtype(np.bool_),
+        "uint8": np.dtype(np.uint8),
+        "uint16": np.dtype(np.uint16),
+        "uint32": np.dtype(np.uint32),
+        "uint64": np.dtype(np.uint64),
+        "int8": np.dtype(np.int8),
+        "int16": np.dtype(np.int16),
+        "int32": np.dtype(np.int32),
+        "int64": np.dtype(np.int64),
+        "float16": np.dtype(np.float16),
+        "float32": np.dtype(np.float32),
+        "float64": np.dtype(np.float64),
+        "complex64": np.dtype(np.complex64),
+        "complex128": np.dtype(np.complex128),
+    }
+    if ml_dtypes is not None:
+        for name in (
+            "bfloat16",
+            "float8_e4m3fn",
+            "float8_e5m2",
+            "float8_e4m3b11fnuz",
+            "float8_e4m3fnuz",
+            "float8_e5m2fnuz",
+            "int4",
+            "uint4",
+            "float4_e2m1fn",
+            "float8_e3m4",
+            "float8_e4m3",
+            "float8_e8m0fnu",
+        ):
+            dt = getattr(ml_dtypes, name, None)
+            if dt is not None:
+                table[name] = np.dtype(dt)
+    return table
+
+
+# Canonical string <-> numpy dtype tables (reference ``serialization.py:58-96``).
+SUPPORTED_DTYPES = _build_dtype_table()
+_DTYPE_TO_STRING = {v: k for k, v in SUPPORTED_DTYPES.items()}
+
+
+def dtype_to_string(dtype) -> str:
+    dtype = np.dtype(dtype)
+    try:
+        return _DTYPE_TO_STRING[dtype]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype for raw serialization: {dtype}")
+
+
+def string_to_dtype(s: str) -> np.dtype:
+    try:
+        return SUPPORTED_DTYPES[s]
+    except KeyError:
+        raise ValueError(f"Unknown dtype string: {s}")
+
+
+def is_raw_serializable(dtype) -> bool:
+    return np.dtype(dtype) in _DTYPE_TO_STRING
+
+
+def dtype_itemsize(s: str) -> int:
+    return string_to_dtype(s).itemsize
+
+
+def array_nbytes(shape, dtype_str: str) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype_itemsize(dtype_str)
+
+
+def array_as_bytes_view(arr: np.ndarray) -> memoryview:
+    """Zero-copy little-endian raw-byte view of ``arr``.
+
+    Copies only when the array is non-contiguous or big-endian (never the case
+    for buffers fetched from an XLA device).
+    """
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    # ml_dtypes custom dtypes reject PEP-3118 export; a uint8 view never does.
+    flat = arr.view(np.uint8).reshape(-1)
+    return memoryview(flat.data)
+
+
+def array_from_bytes(buf, dtype_str: str, shape) -> np.ndarray:
+    """Zero-copy array over ``buf`` (read-only if ``buf`` is)."""
+    dtype = string_to_dtype(dtype_str)
+    expected = array_nbytes(shape, dtype_str)
+    mv = memoryview(buf)
+    if mv.nbytes != expected:
+        raise ValueError(
+            f"Serialized buffer has {mv.nbytes} bytes; "
+            f"expected {expected} for shape {tuple(shape)} dtype {dtype_str}"
+        )
+    return np.frombuffer(mv, dtype=dtype).reshape(shape)

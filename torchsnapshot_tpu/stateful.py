@@ -1,0 +1,23 @@
+"""The app-state protocol (reference ``stateful.py:14-23``).
+
+Anything with ``state_dict()``/``load_state_dict()`` is checkpointable; this
+is a runtime-checkable duck-type so flax/optax wrappers, plain
+:class:`~torchsnapshot_tpu.state_dict.StateDict` objects, and user classes all
+qualify without inheriting anything.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]:
+        ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        ...
+
+
+AppState = Dict[str, Stateful]

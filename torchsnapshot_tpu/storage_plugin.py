@@ -1,0 +1,58 @@
+"""URL -> StoragePlugin dispatch (reference ``storage_plugin.py:17-68``).
+
+Builtin protocols: ``fs://`` (and bare paths), ``memory://``, ``gs://``,
+``s3://``. Third-party plugins register via the ``torchsnapshot_tpu.storage_plugins``
+entry-point group, mirroring the reference's ``storage_plugins`` group.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .io_types import StoragePlugin
+
+
+def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    if "://" in url_path:
+        protocol, _, path = url_path.partition("://")
+        if protocol == "":
+            raise RuntimeError(f"Malformed URL: {url_path}")
+    else:
+        protocol, path = "fs", url_path
+
+    if protocol == "fs":
+        from .storage_plugins.fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path)
+    if protocol == "memory":
+        from .storage_plugins.memory import MemoryStoragePlugin, _SHARED_ROOTS
+
+        return _SHARED_ROOTS.setdefault(path, MemoryStoragePlugin(root=path))
+    if protocol == "gs":
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path)
+    if protocol == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path)
+
+    # Entry-point-registered third-party plugins.
+    try:
+        from importlib.metadata import entry_points
+
+        eps = entry_points(group="torchsnapshot_tpu.storage_plugins")
+        for ep in eps:
+            if ep.name == protocol:
+                return ep.load()(path)
+    except Exception:
+        pass
+    raise RuntimeError(f"Unsupported protocol: {protocol} (in url {url_path})")
+
+
+def url_to_storage_plugin_in_event_loop(
+    url_path: str, event_loop: Optional[asyncio.AbstractEventLoop] = None
+) -> StoragePlugin:
+    # Plugin construction may need the loop (e.g. client session creation).
+    return url_to_storage_plugin(url_path)

@@ -1,0 +1,113 @@
+"""Google Cloud Storage plugin — the TPU-native store.
+
+Analogue of the reference's ``storage_plugins/gcs.py:47-270``: chunked
+resumable uploads/downloads on a thread pool behind the async interface,
+with retry on transient errors and ranged reads for random access.
+
+The ``google-cloud-storage`` SDK is synchronous, so all blob operations run
+in a dedicated thread pool (the reference used the same pattern with 8
+workers); many uploads/downloads therefore proceed concurrently under the
+scheduler's 16-op in-flight cap.
+
+Import of the SDK is lazy and gated: constructing the plugin without
+``google-cloud-storage`` installed raises a clear error instead of failing
+at import time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..memoryview_stream import MemoryviewStream
+
+_IO_THREADS = 8
+_MAX_ATTEMPTS = 5
+_BASE_BACKOFF_S = 0.5
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        try:
+            from google.cloud import storage as gcs  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise RuntimeError(
+                "gs:// storage requires the google-cloud-storage package "
+                "(pip install 'torchsnapshot_tpu[gcs]')"
+            ) from e
+        bucket_name, _, self.prefix = root.partition("/")
+        self._client = gcs.Client()
+        self._bucket = self._client.bucket(bucket_name)
+        self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
+
+    def _blob_path(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    async def _retrying(self, fn) -> object:
+        loop = asyncio.get_event_loop()
+        last: Optional[Exception] = None
+        for attempt in range(_MAX_ATTEMPTS):
+            try:
+                return await loop.run_in_executor(self._executor, fn)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not _is_transient(e) or attempt == _MAX_ATTEMPTS - 1:
+                    raise
+                last = e
+                await asyncio.sleep(
+                    _BASE_BACKOFF_S * (2**attempt) * (0.5 + random.random())
+                )
+        raise last  # pragma: no cover
+
+    async def write(self, write_io: WriteIO) -> None:
+        blob = self._bucket.blob(self._blob_path(write_io.path))
+        mv = memoryview(write_io.buf)
+
+        def upload() -> None:
+            blob.upload_from_file(
+                MemoryviewStream(mv), size=mv.nbytes, rewind=True
+            )
+
+        await self._retrying(upload)
+
+    async def read(self, read_io: ReadIO) -> None:
+        blob = self._bucket.blob(self._blob_path(read_io.path))
+        if read_io.byte_range is None:
+            data = await self._retrying(blob.download_as_bytes)
+        else:
+            begin, end = read_io.byte_range
+            data = await self._retrying(
+                # GCS ranges are inclusive on both ends.
+                lambda: blob.download_as_bytes(start=begin, end=end - 1)
+            )
+        read_io.buf.write(data)
+
+    async def delete(self, path: str) -> None:
+        blob = self._bucket.blob(self._blob_path(path))
+        await self._retrying(blob.delete)
+
+    async def close(self) -> None:
+        self._executor.shutdown(wait=True)
+
+
+def _is_transient(e: Exception) -> bool:
+    try:
+        from google.api_core import exceptions as gexc  # type: ignore[import-not-found]
+
+        if isinstance(
+            e,
+            (
+                gexc.TooManyRequests,
+                gexc.InternalServerError,
+                gexc.BadGateway,
+                gexc.ServiceUnavailable,
+                gexc.GatewayTimeout,
+            ),
+        ):
+            return True
+    except ImportError:
+        pass
+    return isinstance(e, (ConnectionError, TimeoutError))

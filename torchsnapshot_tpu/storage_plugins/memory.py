@@ -1,0 +1,36 @@
+"""In-memory storage plugin, used by tests and as a fault-injection base.
+
+No reference equivalent (the reference injects faults by subclassing its FS
+plugin, ``tests/test_async_take.py:25-40``); a dict-backed plugin makes unit
+tests of the scheduler/batcher/preparers hermetic and fast.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+
+# ``memory://<name>`` URLs resolve to a per-process shared root so a snapshot
+# taken and restored within one process sees the same objects.
+_SHARED_ROOTS: Dict[str, "MemoryStoragePlugin"] = {}
+
+
+class MemoryStoragePlugin(StoragePlugin):
+    def __init__(self, root: str = "") -> None:
+        self.root = root
+        self.objects: Dict[str, bytes] = {}
+
+    async def write(self, write_io: WriteIO) -> None:
+        self.objects[write_io.path] = bytes(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        data = self.objects[read_io.path]
+        if read_io.byte_range is not None:
+            begin, end = read_io.byte_range
+            data = data[begin:end]
+        read_io.buf.write(data)
+
+    async def delete(self, path: str) -> None:
+        del self.objects[path]

@@ -1,0 +1,95 @@
+"""Env-var configuration knobs (reference ``knobs.py:21-98``).
+
+Thresholds govern chunking (pipelining within one array), shard subdivision,
+and small-write batching. Context-manager overrides exist so tests can force
+chunking/batching on tiny arrays.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Generator, Optional
+
+_ENV_MAX_CHUNK = "TORCHSNAPSHOT_TPU_MAX_CHUNK_SIZE_BYTES"
+_ENV_MAX_SHARD = "TORCHSNAPSHOT_TPU_MAX_SHARD_SIZE_BYTES"
+_ENV_SLAB_SIZE_THRESHOLD = "TORCHSNAPSHOT_TPU_SLAB_SIZE_THRESHOLD_BYTES"
+_ENV_ENABLE_BATCHER = "TORCHSNAPSHOT_TPU_ENABLE_BATCHING"
+_ENV_MEMORY_BUDGET = "TORCHSNAPSHOT_TPU_PER_RANK_MEMORY_BUDGET_BYTES"
+_ENV_BARRIER_TIMEOUT = "TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"
+
+# Commit barriers wait for the *slowest* rank's full data write; on large
+# unbalanced snapshots that can far exceed control-plane latencies.
+_DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+
+_DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
+
+
+def _get_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    return int(val) if val is not None else default
+
+
+def get_max_chunk_size_bytes() -> int:
+    return _get_int(_ENV_MAX_CHUNK, _DEFAULT_MAX_CHUNK_SIZE_BYTES)
+
+
+def get_max_shard_size_bytes() -> int:
+    return _get_int(_ENV_MAX_SHARD, _DEFAULT_MAX_SHARD_SIZE_BYTES)
+
+
+def get_slab_size_threshold_bytes() -> int:
+    return _get_int(_ENV_SLAB_SIZE_THRESHOLD, _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES)
+
+
+def is_batching_enabled() -> bool:
+    return os.environ.get(_ENV_ENABLE_BATCHER, "0") not in ("0", "", "false", "False")
+
+
+def get_barrier_timeout_s() -> float:
+    val = os.environ.get(_ENV_BARRIER_TIMEOUT)
+    return float(val) if val is not None else _DEFAULT_BARRIER_TIMEOUT_S
+
+
+def override_barrier_timeout_s(value: float):
+    return _override_env(_ENV_BARRIER_TIMEOUT, str(value))
+
+
+def get_memory_budget_override_bytes() -> Optional[int]:
+    val = os.environ.get(_ENV_MEMORY_BUDGET)
+    return int(val) if val is not None else None
+
+
+@contextlib.contextmanager
+def _override_env(name: str, value: str) -> Generator[None, None, None]:
+    prev = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            del os.environ[name]
+        else:
+            os.environ[name] = prev
+
+
+def override_max_chunk_size_bytes(value: int):
+    return _override_env(_ENV_MAX_CHUNK, str(value))
+
+
+def override_max_shard_size_bytes(value: int):
+    return _override_env(_ENV_MAX_SHARD, str(value))
+
+
+def override_slab_size_threshold_bytes(value: int):
+    return _override_env(_ENV_SLAB_SIZE_THRESHOLD, str(value))
+
+
+def override_batching_enabled(enabled: bool):
+    return _override_env(_ENV_ENABLE_BATCHER, "1" if enabled else "0")
+
+
+def override_memory_budget_bytes(value: int):
+    return _override_env(_ENV_MEMORY_BUDGET, str(value))
