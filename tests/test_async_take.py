@@ -1,0 +1,102 @@
+"""async_take semantics + fault injection
+(reference model: ``tests/test_async_take.py:25-64``)."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.io_types import WriteIO
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import run_with_processes
+
+
+class SlowFSStoragePlugin(FSStoragePlugin):
+    """Delays every write so staging finishes long before I/O does."""
+
+    async def write(self, write_io: WriteIO) -> None:
+        await asyncio.sleep(0.5)
+        await super().write(write_io)
+
+
+class FaultyFSStoragePlugin(FSStoragePlugin):
+    async def write(self, write_io: WriteIO) -> None:
+        raise RuntimeError("injected storage failure")
+
+
+def test_async_take_returns_before_io(tmp_path, monkeypatch) -> None:
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    monkeypatch.setattr(
+        sp, "url_to_storage_plugin", lambda url: SlowFSStoragePlugin(url)
+    )
+    path = str(tmp_path / "ckpt")
+    sd = StateDict(v=np.arange(32, dtype=np.float32))
+    t0 = time.monotonic()
+    pending = Snapshot.async_take(path, {"s": sd})
+    returned_after = time.monotonic() - t0
+    assert returned_after < 0.5  # returned at staging-complete, not io-complete
+    assert not pending.done()
+    # Consistency: mutations after return must not affect the snapshot.
+    sd["v"][:] = -1
+    snap = pending.wait()
+    assert pending.done()
+    tgt = StateDict(v=np.zeros(32, dtype=np.float32))
+    snap.restore({"s": tgt})
+    assert np.array_equal(tgt["v"], np.arange(32, dtype=np.float32))
+
+
+def test_async_take_failure_never_commits(tmp_path, monkeypatch) -> None:
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    monkeypatch.setattr(
+        sp, "url_to_storage_plugin", lambda url: FaultyFSStoragePlugin(url)
+    )
+    path = str(tmp_path / "ckpt")
+    pending = Snapshot.async_take(path, {"s": StateDict(v=np.ones(4))})
+    with pytest.raises(RuntimeError, match="failed"):
+        pending.wait()
+    # The cardinal rule: no partial snapshot is ever committed.
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+
+def _worker_async_rank_failure(rank: int, world_size: int, shared: str) -> None:
+    import torchsnapshot_tpu.storage_plugin as sp
+    from torchsnapshot_tpu import Snapshot as Snap, StateDict as SD
+
+    if rank == 1:
+        sp.url_to_storage_plugin_orig = sp.url_to_storage_plugin
+        sp.url_to_storage_plugin = lambda url: FaultyFSStoragePlugin(url)
+
+    path = os.path.join(shared, "ckpt")
+    pending = Snap.async_take(path, {"s": SD(v=np.full(4, rank))})
+    try:
+        pending.wait()
+        committed = True
+    except RuntimeError:
+        committed = False
+    if rank == 1:
+        assert not committed  # the faulty rank must fail
+    # Leader must never commit when any rank failed.
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+
+@pytest.mark.multiprocess
+def test_async_rank_failure_propagates(tmp_path) -> None:
+    """A failing rank aborts the commit on every rank via the store barrier."""
+    run_with_processes(_worker_async_rank_failure, nproc=2, args=(str(tmp_path),))
+
+
+def test_sync_take_failure_never_commits(tmp_path, monkeypatch) -> None:
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    monkeypatch.setattr(
+        sp, "url_to_storage_plugin", lambda url: FaultyFSStoragePlugin(url)
+    )
+    path = str(tmp_path / "ckpt")
+    with pytest.raises(RuntimeError, match="injected"):
+        Snapshot.take(path, {"s": StateDict(v=np.ones(4))})
+    assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))

@@ -1,0 +1,89 @@
+"""Replicated write-load partitioning (reference model: ``tests/test_partitioner.py``)."""
+
+from typing import List
+
+import numpy as np
+
+from torchsnapshot_tpu.io_preparer import prepare_write
+from torchsnapshot_tpu.manifest import ArrayEntry
+from torchsnapshot_tpu.parallel.coordinator import Coordinator
+from torchsnapshot_tpu.parallel.store import LocalStore
+from torchsnapshot_tpu.partitioner import partition_write_reqs
+from torchsnapshot_tpu.utils import knobs
+
+
+class _FakeCoordinator(Coordinator):
+    """World of N where all_gather returns pre-baked loads."""
+
+    def __init__(self, rank: int, world_size: int, gathered_loads: List[int]):
+        super().__init__(LocalStore(), rank, world_size)
+        self._gathered_loads = gathered_loads
+
+    def all_gather_object(self, obj, timeout_s=None):
+        return list(self._gathered_loads)
+
+
+def _plan(rank: int, replicated: bool):
+    flattened = {
+        f"m/w{i}": np.ones((100 + 50 * i,), dtype=np.float32) for i in range(6)
+    }
+    manifest, reqs = prepare_write(
+        flattened=flattened,
+        rank=rank,
+        world_size=4,
+        replicated_paths=set(flattened) if replicated else set(),
+    )
+    return manifest, reqs
+
+
+def test_replicated_load_spread_across_ranks() -> None:
+    per_rank_reqs = {}
+    for rank in range(4):
+        manifest, reqs = _plan(rank, replicated=True)
+        coord = _FakeCoordinator(rank, 4, [0, 0, 0, 0])
+        per_rank_reqs[rank] = partition_write_reqs(manifest, reqs, coord)
+    all_paths = [r.path for reqs in per_rank_reqs.values() for r in reqs]
+    # Each replicated object written by exactly one rank.
+    assert sorted(all_paths) == sorted({r.path for _, reqs in per_rank_reqs.items() for r in reqs})
+    assert len(all_paths) == 6
+    # Load is spread: no rank takes everything.
+    assert max(len(r) for r in per_rank_reqs.values()) < 6
+
+
+def test_partitioning_respects_existing_load() -> None:
+    manifest, reqs = _plan(0, replicated=True)
+    # Rank 0 already has a big non-replicated load; others are idle.
+    coord = _FakeCoordinator(0, 4, [10**9, 0, 0, 0])
+    mine = partition_write_reqs(manifest, reqs, coord)
+    assert len(mine) == 0  # everything got assigned to idle ranks
+
+
+def test_non_replicated_kept_locally() -> None:
+    manifest, reqs = _plan(2, replicated=False)
+    coord = _FakeCoordinator(2, 4, [0, 0, 0, 0])
+    mine = partition_write_reqs(manifest, reqs, coord)
+    assert len(mine) == len(reqs)  # per-rank writes are never redistributed
+
+
+def test_chunked_replicated_partitions_at_chunk_granularity() -> None:
+    with knobs.override_max_chunk_size_bytes(400):
+        flattened = {"m/big": np.ones((500,), dtype=np.float32)}  # 2000 B -> 5 chunks
+        results = {}
+        for rank in range(2):
+            manifest, reqs = prepare_write(
+                flattened=flattened,
+                rank=rank,
+                world_size=2,
+                replicated_paths={"m/big"},
+            )
+            coord = _FakeCoordinator(rank, 2, [0, 0])
+            results[rank] = [r.path for r in partition_write_reqs(manifest, reqs, coord)]
+    assert len(results[0]) + len(results[1]) == 5
+    assert results[0] and results[1]  # both ranks share the chunks
+    assert not (set(results[0]) & set(results[1]))
+
+
+def test_single_process_passthrough() -> None:
+    manifest, reqs = _plan(0, replicated=True)
+    coord = _FakeCoordinator(0, 1, [0])
+    assert partition_write_reqs(manifest, reqs, coord) is reqs

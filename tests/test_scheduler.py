@@ -1,0 +1,155 @@
+"""Scheduler pipeline semantics: budget, pipelining, PendingIOWork
+(reference model: ``tests/test_scheduler.py`` + ``rss`` benchmarks)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_types import (
+    BufferConsumer,
+    BufferStager,
+    ReadReq,
+    StoragePlugin,
+    WriteIO,
+    ReadIO,
+    WriteReq,
+)
+from torchsnapshot_tpu.scheduler import (
+    execute_read_reqs,
+    execute_write_reqs,
+    get_process_memory_budget_bytes,
+)
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+from torchsnapshot_tpu.utils import knobs
+
+
+class TrackingStager(BufferStager):
+    live = 0
+    peak = 0
+
+    def __init__(self, nbytes: int):
+        self.nbytes = nbytes
+
+    async def stage_buffer(self, executor=None):
+        TrackingStager.live += self.nbytes
+        TrackingStager.peak = max(TrackingStager.peak, TrackingStager.live)
+        await asyncio.sleep(0.01)
+        return bytearray(self.nbytes)
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.nbytes
+
+
+class ReleasingStorage(MemoryStoragePlugin):
+    """Credits TrackingStager.live as buffers are written out."""
+
+    async def write(self, write_io: WriteIO) -> None:
+        await asyncio.sleep(0.01)
+        await super().write(write_io)
+        TrackingStager.live -= memoryview(write_io.buf).nbytes
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _run_write(reqs, storage, budget):
+    # complete() must run on the same loop that created the I/O tasks.
+    async def go():
+        pending = await execute_write_reqs(
+            reqs, storage, memory_budget_bytes=budget, rank=0
+        )
+        await pending.complete()
+
+    _run(go())
+
+
+def test_write_budget_bounds_staged_bytes() -> None:
+    TrackingStager.live = TrackingStager.peak = 0
+    reqs = [WriteReq(f"p{i}", TrackingStager(100)) for i in range(50)]
+    storage = ReleasingStorage()
+    _run_write(reqs, storage, budget=300)
+    assert len(storage.objects) == 50
+    # Peak staged bytes stays within budget + one over-admitted request.
+    assert TrackingStager.peak <= 300 + 100
+
+
+def test_budget_deadlock_avoided_single_huge_req() -> None:
+    TrackingStager.live = TrackingStager.peak = 0
+    reqs = [WriteReq("huge", TrackingStager(10_000))]
+    storage = ReleasingStorage()
+    _run_write(reqs, storage, budget=10)
+    assert len(storage.objects) == 1  # over-budget req still admitted
+
+
+def test_pending_io_work_defers_io() -> None:
+    class SlowStorage(MemoryStoragePlugin):
+        async def write(self, write_io: WriteIO) -> None:
+            await asyncio.sleep(0.05)
+            await super().write(write_io)
+
+    reqs = [WriteReq(f"p{i}", TrackingStager(10)) for i in range(20)]
+    storage = SlowStorage()
+
+    async def staged_then_drain():
+        pending = await execute_write_reqs(
+            reqs, storage, memory_budget_bytes=10**6, rank=0
+        )
+        staged_but_unwritten = len(storage.objects) < 20
+        await pending.complete()
+        return staged_but_unwritten
+
+    assert _run(staged_then_drain())
+    assert len(storage.objects) == 20
+
+
+class CountingConsumer(BufferConsumer):
+    def __init__(self, expected: bytes, box: list):
+        self.expected = expected
+        self.box = box
+
+    async def consume_buffer(self, buf, executor=None) -> None:
+        assert bytes(buf) == self.expected
+        self.box.append(1)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return len(self.expected)
+
+
+def test_read_pipeline_with_ranges() -> None:
+    storage = MemoryStoragePlugin()
+    storage.objects["obj"] = bytes(range(100))
+    box: list = []
+    reqs = [
+        ReadReq("obj", CountingConsumer(bytes(range(100)), box)),
+        ReadReq("obj", CountingConsumer(bytes(range(10, 20)), box), byte_range=(10, 20)),
+    ]
+    _run(execute_read_reqs(reqs, storage, memory_budget_bytes=10**6, rank=0))
+    assert len(box) == 2
+
+
+def test_write_failure_propagates() -> None:
+    class FailingStorage(MemoryStoragePlugin):
+        async def write(self, write_io: WriteIO) -> None:
+            raise OSError("disk full")
+
+    reqs = [WriteReq(f"p{i}", TrackingStager(10)) for i in range(4)]
+
+    async def go():
+        pending = await execute_write_reqs(
+            reqs, FailingStorage(), memory_budget_bytes=10**6, rank=0
+        )
+        await pending.complete()
+
+    with pytest.raises(OSError, match="disk full"):
+        _run(go())
+
+
+def test_memory_budget_override_knob() -> None:
+    with knobs.override_memory_budget_bytes(12345):
+        assert get_process_memory_budget_bytes(None) == 12345

@@ -1,0 +1,122 @@
+"""TCPStore / LocalStore / LinearBarrier unit tests
+(reference model: ``tests/test_dist_store.py``)."""
+
+import pickle
+import threading
+import time
+
+import pytest
+
+from torchsnapshot_tpu.parallel.store import (
+    BarrierError,
+    LinearBarrier,
+    LocalStore,
+    TCPStore,
+    free_port,
+)
+
+
+@pytest.fixture(params=["local", "tcp"])
+def store(request):
+    if request.param == "local":
+        yield LocalStore()
+    else:
+        s = TCPStore("127.0.0.1", 0, is_server=True)
+        yield s
+        s.shutdown()
+
+
+def test_set_get(store) -> None:
+    store.set("k", b"v1")
+    assert store.get("k", timeout_s=1) == b"v1"
+    store.set("k", b"v2")
+    assert store.get("k", timeout_s=1) == b"v2"
+    assert store.try_get("nope") is None
+
+
+def test_blocking_get(store) -> None:
+    def delayed_set():
+        time.sleep(0.2)
+        store.set("later", b"x")
+
+    threading.Thread(target=delayed_set).start()
+    t0 = time.monotonic()
+    assert store.get("later", timeout_s=5) == b"x"
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_get_timeout(store) -> None:
+    with pytest.raises(TimeoutError):
+        store.get("never", timeout_s=0.2)
+
+
+def test_add(store) -> None:
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 2) == 3
+    assert store.add("other", 5) == 5
+
+
+def test_prefix(store) -> None:
+    p1 = store.prefix("a")
+    p2 = store.prefix("b")
+    p1.set("k", b"1")
+    p2.set("k", b"2")
+    assert p1.get("k", timeout_s=1) == b"1"
+    assert p2.get("k", timeout_s=1) == b"2"
+
+
+def test_tcp_store_multiple_clients() -> None:
+    server = TCPStore("127.0.0.1", 0, is_server=True)
+    client = TCPStore("127.0.0.1", server.port, is_server=False)
+    client.set("x", b"from-client")
+    assert server.get("x", timeout_s=1) == b"from-client"
+    server.shutdown()
+
+
+def test_linear_barrier_happy_path() -> None:
+    store = LocalStore()
+    world = 3
+    order = []
+
+    def run(rank):
+        b = LinearBarrier(store, "b1", rank, world)
+        b.arrive(timeout_s=5)
+        if rank == 0:
+            order.append("critical")
+        b.depart(timeout_s=5)
+        order.append(f"done{rank}")
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert order[0] == "critical"
+    assert len(order) == world + 1
+
+
+def test_linear_barrier_error_propagation() -> None:
+    store = LocalStore()
+    world = 2
+    results = {}
+
+    def good(rank):
+        b = LinearBarrier(store, "b2", rank, world)
+        try:
+            b.arrive(timeout_s=5)
+            b.depart(timeout_s=5)
+            results[rank] = "ok"
+        except BarrierError as e:
+            results[rank] = f"barrier-error: {e}"
+
+    def bad(rank):
+        b = LinearBarrier(store, "b2", rank, world)
+        b.report_error(RuntimeError("boom"))
+        results[rank] = "reported"
+
+    t0 = threading.Thread(target=good, args=(0,))
+    t1 = threading.Thread(target=bad, args=(1,))
+    t0.start(), t1.start()
+    t0.join(), t1.join()
+    assert results[1] == "reported"
+    assert "barrier-error" in results[0] and "boom" in results[0]

@@ -68,6 +68,13 @@ def classify(value: Any, world_size: int) -> str:
     if _is_jax_array(value):
         if _globally_replicated(value, world_size):
             return "replicated_array"
+        procs = {d.process_index for d in value.sharding.device_set}
+        if world_size > 1 and len(procs) == 1:
+            # Device set confined to one process: this is per-rank data, not
+            # a slice of a global array. The sharded path would write it to
+            # rank-less ``sharded/<path>`` locations where different ranks'
+            # distinct arrays at the same logical path clobber each other.
+            return "array"
         if len(value.sharding.device_set) == 1:
             return "array"
         return "sharded"
@@ -107,7 +114,11 @@ def prepare_write(
         if kind in ("replicated_array", "array"):
             replicated = kind == "replicated_array" or glob_replicated
             arr = value
-            if _is_jax_array(arr) and len(arr.sharding.device_set) > 1:
+            if (
+                _is_jax_array(arr)
+                and len(arr.sharding.device_set) > 1
+                and arr.sharding.is_fully_replicated
+            ):
                 # Fully-replicated multi-device array: stage from the local copy.
                 arr = arr.addressable_shards[0].data
             storage_path = get_storage_path(logical_path, rank, replicated)

@@ -49,6 +49,17 @@ class Coordinator:
         self._rank = rank
         self._world_size = world_size
         self._generation = 0
+        # Garbage collection of collective keys: a long training run takes
+        # thousands of snapshots, and per-rank manifests are MBs — leaving
+        # every posted key in the store grows rank 0's server unboundedly.
+        # Keys this rank posted, pending deletion: [(generation, full key)].
+        self._posted: List[tuple] = []
+        # Once a *barrier* at generation b completes, every rank has passed
+        # b, hence finished reading all keys from generations < b. Deleting
+        # own keys older than the last completed barrier is therefore safe
+        # (posts from non-barrier collectives alone don't give this
+        # guarantee: a broadcast source never reads, so it can run ahead).
+        self._last_barrier_gen = 0
 
     # -- identity -----------------------------------------------------------
     def get_rank(self) -> int:
@@ -61,20 +72,36 @@ class Coordinator:
     def store(self) -> Store:
         return self._store
 
-    def _next_ns(self, op: str) -> Store:
+    def _next_ns(self, op: str):
         self._generation += 1
-        return self._store.prefix(f"coll/{op}/{self._generation}")
+        self._gc_posted()
+        prefix = f"coll/{op}/{self._generation}"
+        return self._store.prefix(prefix), prefix
+
+    def _post(self, ns_key: str) -> None:
+        self._posted.append((self._generation, ns_key))
+
+    def _gc_posted(self) -> None:
+        while self._posted and self._posted[0][0] < self._last_barrier_gen:
+            _, key = self._posted.pop(0)
+            try:
+                self._store.delete(key)
+            except Exception:
+                break  # cleanup is best-effort
 
     # -- collectives --------------------------------------------------------
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         if self._world_size == 1:
             return
         timeout_s = _resolve_timeout(timeout_s)
-        ns = self._next_ns("barrier")
+        ns, prefix = self._next_ns("barrier")
         count = ns.add("count", 1)
         if count == self._world_size:
             ns.set("done", b"1")
+            self._post(f"{prefix}/done")
+            self._post(f"{prefix}/count")
         ns.get("done", timeout_s=timeout_s)
+        self._last_barrier_gen = self._generation
 
     def all_gather_object(
         self, obj: Any, timeout_s: Optional[float] = None
@@ -82,8 +109,9 @@ class Coordinator:
         if self._world_size == 1:
             return [obj]
         timeout_s = _resolve_timeout(timeout_s)
-        ns = self._next_ns("all_gather")
+        ns, prefix = self._next_ns("all_gather")
         ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        self._post(f"{prefix}/{self._rank}")
         return [
             pickle.loads(ns.get(str(r), timeout_s=timeout_s))
             for r in range(self._world_size)
@@ -95,9 +123,10 @@ class Coordinator:
         if self._world_size == 1:
             return obj
         timeout_s = _resolve_timeout(timeout_s)
-        ns = self._next_ns("broadcast")
+        ns, prefix = self._next_ns("broadcast")
         if self._rank == src:
             ns.set("obj", pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+            self._post(f"{prefix}/obj")
             return obj
         return pickle.loads(ns.get("obj", timeout_s=timeout_s))
 
@@ -107,8 +136,9 @@ class Coordinator:
         if self._world_size == 1:
             return [obj]
         timeout_s = _resolve_timeout(timeout_s)
-        ns = self._next_ns("gather")
+        ns, prefix = self._next_ns("gather")
         ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        self._post(f"{prefix}/{self._rank}")
         if self._rank != dst:
             return None
         return [
@@ -123,11 +153,12 @@ class Coordinator:
             assert objs is not None
             return objs[0]
         timeout_s = _resolve_timeout(timeout_s)
-        ns = self._next_ns("scatter")
+        ns, prefix = self._next_ns("scatter")
         if self._rank == src:
             assert objs is not None and len(objs) == self._world_size
             for r, o in enumerate(objs):
                 ns.set(str(r), pickle.dumps(o, protocol=pickle.HIGHEST_PROTOCOL))
+                self._post(f"{prefix}/{r}")
         return pickle.loads(ns.get(str(self._rank), timeout_s=timeout_s))
 
 

@@ -51,6 +51,9 @@ class Store(abc.ABC):
         """Atomic increment; returns the new value (missing key counts as 0)."""
         ...
 
+    def delete(self, key: str) -> None:
+        """Best-effort removal of a key (and its counter). Default: no-op."""
+
     def prefix(self, p: str) -> "PrefixStore":
         return PrefixStore(p, self)
 
@@ -71,6 +74,9 @@ class PrefixStore(Store):
 
     def add(self, key: str, delta: int) -> int:
         return self._store.add(f"{self._prefix}/{key}", delta)
+
+    def delete(self, key: str) -> None:
+        self._store.delete(f"{self._prefix}/{key}")
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +112,11 @@ class LocalStore(Store):
             self._counters[key] = self._counters.get(key, 0) + delta
             self._cond.notify_all()
             return self._counters[key]
+
+    def delete(self, key: str) -> None:
+        with self._cond:
+            self._data.pop(key, None)
+            self._counters.pop(key, None)
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +180,12 @@ class JaxCoordinationStore(Store):
 
     def add(self, key: str, delta: int) -> int:
         return int(self._client.key_value_increment(self._k(key), delta))
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(self._k(key))
+        except Exception:
+            pass  # cleanup is best-effort
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +254,11 @@ class _StoreHandler(socketserver.BaseRequestHandler):
                     with server.cond:
                         val = server.data.get(key)
                     _send_msg(self.request, ("ok", val))
+                elif op == "delete":
+                    with server.cond:
+                        server.data.pop(key, None)
+                        server.counters.pop(key, None)
+                    _send_msg(self.request, ("ok", None))
                 elif op == "add":
                     with server.cond:
                         server.counters[key] = server.counters.get(key, 0) + arg
@@ -304,6 +326,9 @@ class TCPStore(Store):
 
     def add(self, key: str, delta: int) -> int:
         return self._call("add", key, delta)
+
+    def delete(self, key: str) -> None:
+        self._call("delete", key, None)
 
     def shutdown(self) -> None:
         if self._server is not None:

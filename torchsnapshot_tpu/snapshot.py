@@ -249,8 +249,14 @@ class Snapshot:
             _, write_reqs = batch_write_requests(entries, write_reqs)
 
         global_manifest = cls._gather_manifest(manifest, coord)
-        metadata = SnapshotMetadata(
-            version=__version__, world_size=world_size, manifest=global_manifest
+        # None on non-zero ranks: only the committing rank holds the global
+        # manifest in memory; everyone else reads it lazily post-commit.
+        metadata = (
+            SnapshotMetadata(
+                version=__version__, world_size=world_size, manifest=global_manifest
+            )
+            if global_manifest is not None
+            else None
         )
 
         memory_budget = get_process_memory_budget_bytes(coord)
@@ -517,14 +523,24 @@ class Snapshot:
         return matched
 
     @classmethod
-    def _gather_manifest(cls, manifest: Manifest, coord: Coordinator) -> Manifest:
-        """Merge per-rank manifests into the global rank-namespaced manifest."""
+    def _gather_manifest(
+        cls, manifest: Manifest, coord: Coordinator
+    ) -> Optional[Manifest]:
+        """Merge per-rank manifests into the global rank-namespaced manifest
+        (on rank 0; returns None elsewhere)."""
         from .manifest import entry_from_dict, entry_to_dict
 
         local = {p: entry_to_dict(e) for p, e in manifest.items()}
         if coord.get_world_size() == 1:
             return {f"0/{p}": entry_from_dict(d) for p, d in local.items()}
-        gathered = coord.all_gather_object(local)
+
+        # Gather to rank 0 only: it alone commits the metadata. Pulling W
+        # manifests to all W ranks would be O(W^2 x manifest-size) store
+        # traffic on the take() critical path; non-zero ranks lazily read
+        # the committed ``.snapshot_metadata`` if they ever need it.
+        gathered = coord.gather_object(local, dst=0)
+        if gathered is None:
+            return None
         global_manifest: Manifest = {}
         for r, m in enumerate(gathered):
             for p, d in m.items():

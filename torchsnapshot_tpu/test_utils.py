@@ -71,8 +71,15 @@ def rand_array(shape, dtype: str, seed: Optional[int] = None) -> np.ndarray:
     if dtype == "bool":
         return rng.integers(0, 2, size=shape).astype(np.bool_)
     if dtype.startswith(("int", "uint")):
-        bits = 3 if "4" in dtype else 7
-        return rng.integers(0, 2**bits, size=shape).astype(np_dtype)
+        if dtype in ("int4", "uint4"):
+            return rng.integers(0, 8, size=shape).astype(np_dtype)
+        # Exercise the full byte width (incl. sign bit for signed types).
+        info = np.iinfo(np_dtype)
+        return rng.integers(
+            int(info.min), int(info.max), size=shape, dtype=np.int64
+            if dtype.startswith("int")
+            else np.uint64,
+        ).astype(np_dtype)
     if dtype.startswith("complex"):
         return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(
             np_dtype
@@ -117,6 +124,30 @@ def _worker_entry(
     except BaseException:  # noqa: BLE001
         error_queue.put((rank, traceback.format_exc()))
         raise
+    finally:
+        # Rank 0 hosts the TCPStore server: if it exits the moment its own
+        # work finishes, peers still inside a final store op get their
+        # connections reset. Drain: every rank bumps an exit counter; rank 0
+        # lingers (bounded) until all peers have checked out or failed.
+        try:
+            import time as _time
+
+            from .parallel import coordinator as _coord_mod
+
+            # Only drain through a coordinator the worker actually created:
+            # fabricating one here could build a wrong (world=1) coordinator
+            # on early-failure paths, or retry-connect to a dead server.
+            if _coord_mod._CACHED is not None:
+                store = _coord_mod._CACHED.store
+                store.add("__launcher_exit__", 1)
+                if rank == 0:
+                    deadline = _time.monotonic() + 20
+                    while _time.monotonic() < deadline:
+                        if store.add("__launcher_exit__", 0) >= world_size:
+                            break
+                        _time.sleep(0.05)
+        except Exception:
+            pass
 
 
 def run_with_processes(
