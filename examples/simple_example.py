@@ -1,0 +1,75 @@
+"""The canonical resume-loop idiom (reference ``examples/simple_example.py:50-82``).
+
+Run:  python examples/simple_example.py [--snapshot-path PATH]
+
+Captures training progress in a StateDict, restores it when a snapshot path
+is given, then takes a snapshot every epoch — killing and relaunching the
+script mid-run resumes exactly where it stopped.
+"""
+
+import argparse
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torchsnapshot_tpu import RNGState, Snapshot, StateDict
+from torchsnapshot_tpu.tricks.train_state import Box, PyTreeStateful
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--snapshot-path", default=None)
+    parser.add_argument("--epochs", type=int, default=4)
+    args = parser.parse_args()
+
+    # A tiny linear-regression "model".
+    key = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(key, (16, 1)), "b": jnp.zeros((1,))}
+    tx = optax.sgd(1e-2)
+    opt_state = tx.init(params)
+
+    holder = Box({"params": params, "opt_state": opt_state})
+    progress = StateDict(epoch=0)
+    app_state = {
+        "train_state": PyTreeStateful(holder),
+        "progress": progress,
+        "rng": RNGState(),
+    }
+
+    if args.snapshot_path is not None and os.path.exists(
+        os.path.join(args.snapshot_path, ".snapshot_metadata")
+    ):
+        Snapshot(args.snapshot_path).restore(app_state)
+        print(f"resumed from epoch {progress['epoch']}")
+
+    snapshot_root = args.snapshot_path or tempfile.mkdtemp()
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            pred = x @ p["w"] + p["b"]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    data_key = jax.random.PRNGKey(42)
+    while progress["epoch"] < args.epochs:
+        x = jax.random.normal(data_key, (128, 16))
+        y = x @ jnp.ones((16, 1))
+        state = holder.value
+        params, opt_state, loss = train_step(
+            state["params"], state["opt_state"], x, y
+        )
+        holder.value = {"params": params, "opt_state": opt_state}
+        progress["epoch"] += 1
+        snapshot = Snapshot.take(snapshot_root, app_state)
+        print(f"epoch {progress['epoch']}: loss={float(loss):.4f} -> {snapshot.path}")
+
+
+if __name__ == "__main__":
+    main()

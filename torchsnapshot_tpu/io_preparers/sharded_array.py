@@ -134,7 +134,11 @@ class ShardedArrayBufferConsumer(BufferConsumer):
             else:
                 src = pickle.loads(bytes(buf))
             for dst, src_slices, dst_slices in self.copy_specs:
-                np.copyto(dst[dst_slices], src[src_slices], casting="no")
+                # 0-d arrays: an empty slice tuple indexes out a scalar, so
+                # copy into the array object itself.
+                dst_view = dst[dst_slices] if dst_slices else dst
+                src_view = src[src_slices] if src_slices else src
+                np.copyto(dst_view, src_view, casting="no")
 
         loop = asyncio.get_event_loop()
         if executor is not None:

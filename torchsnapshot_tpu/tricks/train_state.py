@@ -1,0 +1,85 @@
+"""Stateful adapters for JAX training states (pytrees).
+
+The TPU-native analogue of the reference's framework adapters
+(``tricks/deepspeed.py:30-103`` monkey-patched DeepSpeed engines; flax/optax
+need no monkey-patching — any pytree becomes checkpointable through these
+wrappers):
+
+- :class:`PyTreeStateful` wraps a *mutable holder* of an arbitrary pytree
+  (flax ``TrainState``, raw param dicts, optax opt states with their
+  NamedTuple nesting). ``state_dict()`` flattens the tree to
+  ``{path: leaf}``; ``load_state_dict`` rebuilds the identical treedef with
+  restored leaves, so sharded ``jax.Array`` leaves restore into their live
+  shardings (in-place semantics for an immutable world: the holder's value
+  is *replaced*, never mutated).
+- :func:`train_state_stateful` is the one-liner for the common case.
+
+Usage::
+
+    holder = Box(train_state)
+    app_state = {"train_state": PyTreeStateful(holder), "rng": RNGState()}
+    Snapshot.take(path, app_state)
+    ...
+    Snapshot(path).restore(app_state)   # holder.value is the restored state
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+
+class Box(Generic[T]):
+    """A mutable cell: JAX states are immutable, so restore replaces the value."""
+
+    def __init__(self, value: T) -> None:
+        self.value = value
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return "/".join(parts) if parts else "value"
+
+
+class PyTreeStateful:
+    """Checkpoint any pytree through a :class:`Box` holder."""
+
+    def __init__(self, holder: Box) -> None:
+        self._holder = holder
+
+    def state_dict(self) -> Dict[str, Any]:
+        leaves = jax.tree_util.tree_flatten_with_path(self._holder.value)[0]
+        return {_path_str(path): leaf for path, leaf in leaves}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        live = self._holder.value
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(live)
+        new_leaves = []
+        for path, live_leaf in paths_and_leaves:
+            key = _path_str(path)
+            if key not in state_dict:
+                raise KeyError(
+                    f"Snapshot is missing pytree leaf {key!r}; "
+                    f"available: {sorted(state_dict.keys())[:10]}..."
+                )
+            new_leaves.append(state_dict[key])
+        self._holder.value = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def train_state_stateful(holder: Box) -> PyTreeStateful:
+    """Adapter for ``flax.training.train_state.TrainState`` (or any pytree)."""
+    return PyTreeStateful(holder)
