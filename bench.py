@@ -89,17 +89,14 @@ def main() -> None:
         pending.wait()
         log(f"async take stall: {stall_s:.2f}s (train-step blocked time)")
 
-        # Restore bit-exactness spot check on one layer.
-        import jax.numpy as jnp
-
+        # Restore bit-exactness spot check on one layer via random access
+        # (restore() would load the full snapshot; read_object fetches only
+        # the probed leaves).
+        snap = Snapshot(os.path.join(root, "ckpt"))
         first = next(iter(params))
-        tgt = StateDict(
-            **{first: {k: jnp.zeros_like(v) for k, v in params[first].items()}}
-        )
-        Snapshot(os.path.join(root, "ckpt")).restore({"model": tgt})
         ok = all(
             np.array_equal(
-                np.asarray(tgt[first][k]).view(np.uint8),
+                np.asarray(snap.read_object(f"0/model/{first}/{k}")).view(np.uint8),
                 np.asarray(params[first][k]).view(np.uint8),
             )
             for k in params[first]

@@ -39,13 +39,14 @@ def main() -> None:
         "rng": RNGState(),
     }
 
-    if args.snapshot_path is not None and os.path.exists(
-        os.path.join(args.snapshot_path, ".snapshot_metadata")
-    ):
-        Snapshot(args.snapshot_path).restore(app_state)
-        print(f"resumed from epoch {progress['epoch']}")
-
+    # One snapshot path per epoch: a kill mid-take can then never tear an
+    # existing snapshot (take never commits partial state, but overwriting a
+    # committed snapshot in place would mix old metadata with new data).
     snapshot_root = args.snapshot_path or tempfile.mkdtemp()
+    latest = _latest_epoch_snapshot(snapshot_root)
+    if latest is not None:
+        Snapshot(latest).restore(app_state)
+        print(f"resumed from epoch {progress['epoch']}")
 
     @jax.jit
     def train_step(params, opt_state, x, y):
@@ -67,8 +68,22 @@ def main() -> None:
         )
         holder.value = {"params": params, "opt_state": opt_state}
         progress["epoch"] += 1
-        snapshot = Snapshot.take(snapshot_root, app_state)
+        snapshot = Snapshot.take(
+            os.path.join(snapshot_root, f"epoch_{progress['epoch']}"), app_state
+        )
         print(f"epoch {progress['epoch']}: loss={float(loss):.4f} -> {snapshot.path}")
+
+
+def _latest_epoch_snapshot(root: str):
+    if not os.path.isdir(root):
+        return None
+    epochs = []
+    for name in os.listdir(root):
+        if name.startswith("epoch_") and os.path.exists(
+            os.path.join(root, name, ".snapshot_metadata")
+        ):
+            epochs.append(int(name.split("_")[1]))
+    return os.path.join(root, f"epoch_{max(epochs)}") if epochs else None
 
 
 if __name__ == "__main__":

@@ -140,10 +140,10 @@ def param_spec(path: str, fsdp: bool = True) -> P:
         return P(dp, "tp")
     if "down/kernel" in path:  # (d_ff, d_model)
         return P("tp", dp)
+    if "pos_embed/embedding" in path:  # must precede the embed match below
+        return P(dp, None)
     if "embed/embedding" in path or "lm_head/kernel" in path:
         return P(dp, "tp")
-    if "pos_embed/embedding" in path:
-        return P(dp, None)
     return P()  # layer norms, biases: replicated
 
 
@@ -151,9 +151,10 @@ def shard_params(params, mesh: Mesh, fsdp: bool = True):
     """Place a param pytree on ``mesh`` under the TP/FSDP rules, falling back
     to replication when a dim isn't divisible by its mesh axis."""
 
+    from ..tricks.train_state import _path_str
+
     def place(path, leaf):
-        pstr = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        spec = param_spec(pstr, fsdp=fsdp)
+        spec = param_spec(_path_str(path), fsdp=fsdp)
         spec = _fit_spec(spec, leaf.shape, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 

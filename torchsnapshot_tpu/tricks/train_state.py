@@ -55,28 +55,59 @@ def _path_str(path) -> str:
     return "/".join(parts) if parts else "value"
 
 
+def _path_parts(path) -> list:
+    return [p_str for p_str in (_key_part(p) for p in path)] or ["value"]
+
+
+def _key_part(p) -> str:
+    if isinstance(p, jax.tree_util.DictKey):
+        return str(p.key)
+    if isinstance(p, jax.tree_util.SequenceKey):
+        return str(p.idx)
+    if isinstance(p, jax.tree_util.GetAttrKey):
+        return p.name
+    if isinstance(p, jax.tree_util.FlattenedIndexKey):
+        return str(p.key)
+    return str(p)
+
+
 class PyTreeStateful:
-    """Checkpoint any pytree through a :class:`Box` holder."""
+    """Checkpoint any pytree through a :class:`Box` holder.
+
+    ``state_dict()`` mirrors the pytree as *nested* dicts keyed by path
+    components, so snapshot logical paths stay natural —
+    ``read_object("0/train_state/params/dense/kernel")`` works — instead of
+    flat ``a/b/c`` keys whose slashes would be escaped in the manifest.
+    """
 
     def __init__(self, holder: Box) -> None:
         self._holder = holder
 
     def state_dict(self) -> Dict[str, Any]:
-        leaves = jax.tree_util.tree_flatten_with_path(self._holder.value)[0]
-        return {_path_str(path): leaf for path, leaf in leaves}
+        nested: Dict[str, Any] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._holder.value)[0]:
+            parts = _path_parts(path)
+            node = nested
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = leaf
+        return nested
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         live = self._holder.value
         paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(live)
         new_leaves = []
-        for path, live_leaf in paths_and_leaves:
-            key = _path_str(path)
-            if key not in state_dict:
-                raise KeyError(
-                    f"Snapshot is missing pytree leaf {key!r}; "
-                    f"available: {sorted(state_dict.keys())[:10]}..."
-                )
-            new_leaves.append(state_dict[key])
+        for path, _ in paths_and_leaves:
+            parts = _path_parts(path)
+            node: Any = state_dict
+            for part in parts:
+                if not isinstance(node, dict) or part not in node:
+                    raise KeyError(
+                        f"Snapshot is missing pytree leaf {'/'.join(parts)!r}; "
+                        f"available top-level keys: {sorted(state_dict)[:10]}"
+                    )
+                node = node[part]
+            new_leaves.append(node)
         self._holder.value = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
