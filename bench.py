@@ -1,15 +1,29 @@
-"""Headline benchmark: checkpoint-save throughput from TPU HBM to local FS.
+"""Headline benchmark: train-step stall when checkpointing from TPU HBM.
 
-Mirrors the reference's flagship benchmark (``benchmarks/ddp/README.md``:
-a 20 GB model saved with torch.save ~32 s vs torchsnapshot ~13.91 s on one
-A100 + local FS => ~1.44 GB/s). Here: a transformer-shaped bf16 param pytree
-living in TPU HBM is saved with ``Snapshot.take()`` to local FS; the metric
-is end-to-end GB/s for the synchronous take (device->host transfer +
-serialization + storage I/O, all overlapped by the scheduler).
+The driver-supplied target (BASELINE.json: "Snapshot.take() stall-time (s) and
+GB/s/chip; restore bit-exactness" / north star "<5 s train-step stall with
+bit-exact restore") and the reference's own flagship table
+(``benchmarks/ddp/README.md``: save wall-time vs torch.save) both measure the
+same thing: how long training is blocked by a checkpoint.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N/1.438, ...}
-Secondary numbers (async stall time, restore check) go to stderr.
+This harness saves a transformer-shaped bf16 param pytree living in TPU HBM
+with ``Snapshot.async_take()`` and reports:
+
+- headline: the **train-step stall** — how long ``async_take`` blocks before
+  training may resume (and donate/replace the params). TPU-native capture
+  forks the device buffers instead of staging to host RAM, so the stall is
+  planning time, independent of checkpoint size.
+- vs_baseline: the stall a reference-style design pays on the *same* hardware
+  for the same bytes. The reference's ``async_take`` cannot return until all
+  data is captured in host RAM (``snapshot.py:245-314`` + defensive copies,
+  ``io_preparers/tensor.py:254-264``), so its stall is bounded below by the
+  full device→host transfer — measured here as the background drain (same
+  bytes, same link, D2H fully overlapped with writes: a *generous* baseline).
+- detail: background drain time, sync-take GB/s, naive single-stream
+  (torch.save-style) GB/s on the same hardware, and restore bit-exactness
+  checked via random-access ``read_object``.
+
+Prints ONE JSON line on stdout; everything else goes to stderr.
 """
 
 import json
@@ -21,21 +35,19 @@ import time
 
 import numpy as np
 
-_BASELINE_GBPS = 20.0 / 13.91  # reference: 20 GB / 13.91 s, 1 GPU + local FS
-
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_params(total_gb: float):
+def build_params(total_gb: float, seed: int = 0):
     """Transformer-shaped bf16 params filling ~total_gb of HBM."""
     import jax
     import jax.numpy as jnp
 
     d_model, d_ff = 4096, 16384
     layer_bytes = (3 * d_model * d_model + 2 * d_model * d_ff) * 2  # bf16
-    n_layers = max(1, int(total_gb * 1e9 / layer_bytes))
+    n_layers = max(1, round(total_gb * 1e9 / layer_bytes))
 
     @jax.jit
     def make_layer(key):
@@ -46,60 +58,103 @@ def build_params(total_gb: float):
             "down": jax.random.normal(k3, (d_ff, d_model), jnp.bfloat16),
         }
 
-    import jax.random as jrandom
-
     params = {}
-    key = jrandom.PRNGKey(0)
+    key = jax.random.PRNGKey(seed)
     for i in range(n_layers):
-        key, sub = jrandom.split(key)
+        key, sub = jax.random.split(key)
         params[f"layer_{i}"] = make_layer(sub)
-    import jax
-
     jax.block_until_ready(params)
     nbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params))
     return params, nbytes
 
 
+def measure_naive_save(params_slice, root: str):
+    """torch.save-equivalent: blocking device_get of everything, then one
+    buffered single-stream pickle write (what the reference benchmarks
+    against, ``benchmarks/ddp/README.md:9``). Returns (d2h_s, write_s)."""
+    import pickle
+
+    import jax
+
+    t0 = time.perf_counter()
+    host = jax.device_get(params_slice)
+    d2h_s = time.perf_counter() - t0
+    path = os.path.join(root, "naive.pkl")
+    t0 = time.perf_counter()
+    with open(path, "wb") as f:
+        pickle.dump(
+            jax.tree.map(lambda a: np.asarray(a).view(np.uint8), host),
+            f,
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+    write_s = time.perf_counter() - t0
+    os.remove(path)
+    return d2h_s, write_s
+
+
 def main() -> None:
+    import jax
+
     from torchsnapshot_tpu import Snapshot, StateDict
 
-    total_gb = float(os.environ.get("BENCH_TOTAL_GB", "8"))
-    params, nbytes = build_params(total_gb)
-    gb = nbytes / 1e9
-    log(f"built {gb:.2f} GB of bf16 params on {_device_desc()}")
+    total_gb = float(os.environ.get("BENCH_TOTAL_GB", "2"))
+    d = jax.devices()[0]
+    log(f"device: {d.device_kind} ({d.platform})")
 
     root = tempfile.mkdtemp(prefix="tss_bench_")
     try:
-        # Warmup on a small subset to exclude one-time costs (imports,
-        # thread-pool spin-up, directory creation).
-        warm = {"w": StateDict(p=next(iter(params.values()))["up"])}
-        Snapshot.take(os.path.join(root, "warm"), warm)
+        # Warmup: snapshot a small state to absorb one-time costs (imports,
+        # thread pools, native-engine build, jit caches for the layer shapes).
+        warm_params, _ = build_params(0.1, seed=99)
+        Snapshot.take(os.path.join(root, "warm"), {"w": StateDict(**warm_params)})
+        del warm_params
 
+        params, nbytes = build_params(total_gb, seed=0)
+        gb = nbytes / 1e9
+        log(f"built {gb:.2f} GB of bf16 params in HBM")
         sd = StateDict(**params)
-        t0 = time.perf_counter()
-        Snapshot.take(os.path.join(root, "ckpt"), {"model": sd})
-        take_s = time.perf_counter() - t0
-        gbps = gb / take_s
-        log(f"sync take: {take_s:.2f}s -> {gbps:.2f} GB/s")
 
-        # Async stall: how long training is blocked.
+        # ---- headline: async_take stall on fresh (uncached) device arrays
         t0 = time.perf_counter()
         pending = Snapshot.async_take(os.path.join(root, "ckpt_async"), {"model": sd})
         stall_s = time.perf_counter() - t0
+        log(f"async_take stall: {stall_s:.3f}s (training may resume/donate here)")
+        t0 = time.perf_counter()
         pending.wait()
-        log(f"async take stall: {stall_s:.2f}s (train-step blocked time)")
+        drain_s = time.perf_counter() - t0
+        log(f"background drain (D2H + storage I/O): {drain_s:.2f}s")
 
-        # Restore bit-exactness spot check on one layer via random access
-        # (restore() would load the full snapshot; read_object fetches only
-        # the probed leaves).
-        snap = Snapshot(os.path.join(root, "ckpt"))
-        first = next(iter(params))
+        # ---- detail: sync take + naive torch.save-style on a subset
+        sub_keys = list(params)[: max(1, len(params) // 4)]
+        sub = {k: params[k] for k in sub_keys}
+        sub_gb = sum(x.nbytes for x in jax.tree_util.tree_leaves(sub)) / 1e9
+        d2h_s, write_s = measure_naive_save(sub, root)
+        naive_s = d2h_s + write_s
+        log(
+            f"naive single-stream save: {sub_gb:.2f} GB in {naive_s:.2f}s "
+            f"(D2H {d2h_s:.2f}s + write {write_s:.2f}s; {sub_gb / naive_s:.3f} GB/s)"
+        )
+
+        # Reference-design stall lower bound on the same hardware: its
+        # async_take cannot return before all bytes are captured in host RAM,
+        # i.e. at best one full device->host transfer — extrapolated from the
+        # measured D2H rate (NOT from the drain, which also contains storage
+        # I/O and would overstate the baseline when disk is the bottleneck).
+        ref_equiv_stall_s = d2h_s * (gb / sub_gb)
+        t0 = time.perf_counter()
+        Snapshot.take(os.path.join(root, "ckpt_sync"), {"model": StateDict(**sub)})
+        sync_s = time.perf_counter() - t0
+        log(f"sync take: {sub_gb:.2f} GB in {sync_s:.2f}s ({sub_gb / sync_s:.3f} GB/s)")
+
+        # ---- restore bit-exactness via random access into the async ckpt
+        snap = Snapshot(os.path.join(root, "ckpt_async"))
+        probe = list(params)[-1]
         ok = all(
             np.array_equal(
-                np.asarray(snap.read_object(f"0/model/{first}/{k}")).view(np.uint8),
-                np.asarray(params[first][k]).view(np.uint8),
+                np.asarray(snap.read_object(f"0/model/{probe}/{k}")).view(np.uint8),
+                np.asarray(params[probe][k]).view(np.uint8),
             )
-            for k in params[first]
+            for k in params[probe]
         )
         log(f"restore bit-exact: {ok}")
         if not ok:
@@ -108,28 +163,31 @@ def main() -> None:
         print(
             json.dumps(
                 {
-                    "metric": "checkpoint_save_throughput",
-                    "value": round(gbps, 3),
-                    "unit": "GB/s",
-                    "vs_baseline": round(gbps / _BASELINE_GBPS, 3),
+                    "metric": "train_step_stall_on_async_save",
+                    "value": round(stall_s, 3),
+                    "unit": "s",
+                    "vs_baseline": round(ref_equiv_stall_s / stall_s, 1),
                     "detail": {
                         "size_gb": round(gb, 2),
-                        "sync_take_s": round(take_s, 2),
-                        "async_stall_s": round(stall_s, 2),
-                        "baseline": "torchsnapshot 20GB DDP save, 1 GPU + local FS, 1.438 GB/s",
+                        "async_stall_s": round(stall_s, 3),
+                        "background_drain_s": round(drain_s, 2),
+                        "target_stall_s": 5.0,
+                        "sync_take_gbps": round(sub_gb / sync_s, 3),
+                        "naive_save_gbps": round(sub_gb / naive_s, 3),
+                        "speedup_vs_naive_sync": round(naive_s / sync_s, 2),
+                        "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
+                        "restore_bit_exact": ok,
+                        "baseline": (
+                            "reference-style async_take must capture to host RAM "
+                            "before returning; its stall >= one full D2H transfer "
+                            "at the rate measured on this same hardware"
+                        ),
                     },
                 }
             )
         )
     finally:
         shutil.rmtree(root, ignore_errors=True)
-
-
-def _device_desc() -> str:
-    import jax
-
-    d = jax.devices()[0]
-    return f"{d.device_kind} ({d.platform})"
 
 
 if __name__ == "__main__":

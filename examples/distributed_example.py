@@ -16,6 +16,9 @@ devices if you set XLA_FLAGS=--xla_force_host_platform_device_count=8).
 
 import os
 import tempfile
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

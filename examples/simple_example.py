@@ -10,6 +10,9 @@ script mid-run resumes exactly where it stopped.
 import argparse
 import os
 import tempfile
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -79,11 +82,15 @@ def _latest_epoch_snapshot(root: str):
         return None
     epochs = []
     for name in os.listdir(root):
-        if name.startswith("epoch_") and os.path.exists(
+        if not name.startswith("epoch_") or not os.path.exists(
             os.path.join(root, name, ".snapshot_metadata")
         ):
-            epochs.append(int(name.split("_")[1]))
-    return os.path.join(root, f"epoch_{max(epochs)}") if epochs else None
+            continue
+        try:
+            epochs.append((int(name.split("_", 1)[1]), name))
+        except ValueError:
+            continue  # e.g. a checkpoint copied aside as epoch_old/
+    return os.path.join(root, max(epochs)[1]) if epochs else None
 
 
 if __name__ == "__main__":

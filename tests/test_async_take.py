@@ -49,6 +49,38 @@ def test_async_take_returns_before_io(tmp_path, monkeypatch) -> None:
     assert np.array_equal(tgt["v"], np.arange(32, dtype=np.float32))
 
 
+def test_async_take_survives_donation(tmp_path) -> None:
+    """Training may donate (invalidate) the checkpointed jax arrays right
+    after ``async_take`` returns; the on-device defensive fork
+    (``io_preparer._defensive_device_copy``) keeps the capture intact."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(1024, dtype=jnp.float32)
+    path = str(tmp_path / "ckpt")
+    pending = Snapshot.async_take(path, {"s": StateDict(x=x)})
+    x.delete()  # what donate_argnums does to every reference
+    snap = pending.wait()
+    tgt = StateDict(x=jnp.zeros(1024, dtype=jnp.float32))
+    snap.restore({"s": tgt})
+    assert np.array_equal(np.asarray(tgt["x"]), np.arange(1024, dtype=np.float32))
+
+
+def test_async_take_device_copy_disabled_still_works_without_donation(
+    tmp_path,
+) -> None:
+    from torchsnapshot_tpu.utils import knobs
+
+    import jax.numpy as jnp
+
+    with knobs.override_async_device_copy(False):
+        x = jnp.arange(16, dtype=jnp.float32)
+        pending = Snapshot.async_take(str(tmp_path / "ckpt"), {"s": StateDict(x=x)})
+        snap = pending.wait()
+        tgt = StateDict(x=jnp.zeros(16, dtype=jnp.float32))
+        snap.restore({"s": tgt})
+        assert np.array_equal(np.asarray(tgt["x"]), np.arange(16, dtype=np.float32))
+
+
 def test_async_take_failure_never_commits(tmp_path, monkeypatch) -> None:
     import torchsnapshot_tpu.storage_plugin as sp
 

@@ -1,0 +1,141 @@
+"""Native O_DIRECT I/O engine tests (``torchsnapshot_tpu/native``).
+
+Covers: build+load, write/read round-trips at aligned/unaligned sizes,
+ranged reads at unaligned offsets, buffered fallback on filesystems without
+O_DIRECT (tmpfs), the disable knob, and FS-plugin integration parity with the
+pure-Python path.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import native
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.utils import knobs
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load_native()
+    if lib is None:
+        pytest.skip("native IO engine unavailable")
+    return lib
+
+
+def test_version(lib) -> None:
+    assert lib.tss_io_version() >= 1
+
+
+@pytest.mark.parametrize(
+    "nbytes",
+    [
+        0,
+        1,
+        4095,
+        4096,
+        4097,
+        1 << 20,
+        (1 << 20) + 13,
+        3 * 4096,
+    ],
+)
+def test_write_read_roundtrip(lib, tmp_path, nbytes: int) -> None:
+    rng = np.random.default_rng(nbytes)
+    data = rng.integers(0, 256, size=nbytes, dtype=np.uint8)
+    path = str(tmp_path / f"f{nbytes}")
+    native.write_file(lib, path, data, direct=True, chunk_bytes=1 << 20)
+    assert os.path.getsize(path) == nbytes
+    assert native.file_size(lib, path) == nbytes
+
+    out = bytearray(nbytes)
+    native.read_into(lib, path, out, offset=0, direct=True, chunk_bytes=1 << 20)
+    assert bytes(out) == data.tobytes()
+
+
+def test_small_chunk_many_iterations(lib, tmp_path) -> None:
+    """Chunk smaller than payload: exercises the bounce-buffer loop."""
+    data = np.arange(64 * 1024, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "chunked")
+    native.write_file(lib, path, data, direct=True, chunk_bytes=4096)
+    out = bytearray(len(data))
+    native.read_into(lib, path, out, direct=True, chunk_bytes=4096)
+    assert bytes(out) == data
+
+
+@pytest.mark.parametrize("offset,length", [(0, 100), (1, 4096), (4095, 2), (8192, 8192), (5000, 70001)])
+def test_ranged_read_unaligned(lib, tmp_path, offset: int, length: int) -> None:
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "ranged")
+    native.write_file(lib, path, data, direct=True, chunk_bytes=1 << 20)
+    out = bytearray(length)
+    native.read_into(lib, path, out, offset=offset, direct=True, chunk_bytes=16384)
+    assert bytes(out) == data[offset : offset + length]
+
+
+def test_read_past_eof_raises(lib, tmp_path) -> None:
+    path = str(tmp_path / "short")
+    native.write_file(lib, path, b"x" * 100, direct=True, chunk_bytes=4096)
+    out = bytearray(200)
+    with pytest.raises(OSError):
+        native.read_into(lib, path, out, offset=0, direct=True)
+
+
+def test_missing_file_raises(lib, tmp_path) -> None:
+    out = bytearray(10)
+    with pytest.raises(OSError):
+        native.read_into(lib, str(tmp_path / "nope"), out)
+
+
+def test_tmpfs_fallback(lib) -> None:
+    """tmpfs rejects O_DIRECT; the engine must fall back to buffered I/O."""
+    if not os.path.isdir("/dev/shm"):
+        pytest.skip("no tmpfs mount")
+    path = f"/dev/shm/tss_native_test_{os.getpid()}"
+    try:
+        data = os.urandom(123_456)
+        native.write_file(lib, path, data, direct=True, chunk_bytes=1 << 20)
+        out = bytearray(len(data))
+        native.read_into(lib, path, out, direct=True)
+        assert bytes(out) == data
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def test_disable_knob(monkeypatch) -> None:
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DISABLE_NATIVE_IO", "1")
+    assert native.load_native() is None
+    assert not knobs.is_native_io_enabled()
+
+
+def _plugin_roundtrip(plugin: FSStoragePlugin, nbytes: int) -> None:
+    data = os.urandom(nbytes)
+    plugin.sync_write(WriteIO(path="obj", buf=data))
+    read_io = ReadIO(path="obj")
+    plugin.sync_read(read_io)
+    assert read_io.buf.getvalue() == data
+    # ranged read across the native threshold boundary
+    read_io = ReadIO(path="obj", byte_range=(nbytes // 3, nbytes // 3 + nbytes // 2))
+    plugin.sync_read(read_io)
+    assert read_io.buf.getvalue() == data[nbytes // 3 : nbytes // 3 + nbytes // 2]
+    plugin.sync_close()
+
+
+def test_fs_plugin_native_path(tmp_path) -> None:
+    with knobs.override_direct_io_threshold_bytes(1024):
+        plugin = FSStoragePlugin(str(tmp_path))
+        if plugin._native is None:
+            pytest.skip("native IO engine unavailable")
+        _plugin_roundtrip(plugin, 1 << 20)
+
+
+def test_fs_plugin_python_path_parity(tmp_path) -> None:
+    with knobs.override_native_io_enabled(False):
+        plugin = FSStoragePlugin(str(tmp_path))
+        assert plugin._native is None
+        _plugin_roundtrip(plugin, 1 << 20)

@@ -82,6 +82,10 @@ class BatchedBufferStager(BufferStager):
     def get_staging_cost_bytes(self) -> int:
         return self.total
 
+    def start_d2h_hint(self) -> None:
+        for req, _, _ in self.members:
+            req.buffer_stager.start_d2h_hint()
+
 
 def batch_write_requests(
     entries: List[Entry], write_reqs: List[WriteReq]
@@ -127,7 +131,14 @@ def batch_write_requests(
             entry.location = slab_path
             entry.byte_range = [begin, end]
         batched_reqs.append(
-            WriteReq(path=slab_path, buffer_stager=BatchedBufferStager(slab))
+            WriteReq(
+                path=slab_path,
+                buffer_stager=BatchedBufferStager(slab),
+                # Deferring past async_take's return is only safe when every
+                # member is (immutable device data); one mutable host member
+                # forces the whole slab to stage at the capture point.
+                defer_staging=all(req.defer_staging for req, _, _ in slab),
+            )
         )
         slab, slab_entries, offset = [], [], 0
 

@@ -83,6 +83,32 @@ def classify(value: Any, world_size: int) -> str:
     return "object"
 
 
+def _defensive_device_copy(arr: Any) -> Any:
+    """Fork a jax array's device buffers for async capture.
+
+    TPU-native replacement for the reference's defensive *host* copies
+    (``io_preparers/tensor.py:254-278``): torch must capture mutable tensors
+    in host RAM before ``async_take`` returns; jax arrays are immutable, so
+    the only hazard is the training step *donating* the buffers
+    (``donate_argnums``), which marks every reference deleted. An on-device
+    copy (dispatched asynchronously — microseconds on the host timeline,
+    HBM-bandwidth on the device) detaches the snapshot from donation.
+
+    The copy runs under an explicit ``jit`` pinned to the array's own
+    sharding: eager ``jnp.copy`` would raise on non-fully-addressable
+    (multi-process) global arrays, and every rank reaches this point in the
+    same gathered-key order, so the SPMD requirement holds.
+    """
+    from .utils import knobs
+
+    if knobs.is_async_device_copy_enabled():
+        import jax
+        import jax.numpy as jnp
+
+        arr = jax.jit(jnp.copy, out_shardings=arr.sharding)(arr)
+    return arr
+
+
 def prepare_write(
     flattened: Dict[str, Any],
     rank: int,
@@ -94,6 +120,14 @@ def prepare_write(
     manifest: Manifest = {}
     write_reqs: List[WriteReq] = []
     for logical_path, value in flattened.items():
+        is_device_value = _is_jax_array(value)
+        if is_async_snapshot and is_device_value:
+            # Device arrays are immutable; fork them against donation and
+            # defer their staging past async_take's return. Mutable host
+            # state keeps defer_staging=False and is captured (staged under
+            # the budget) before async_take returns — the reference's
+            # semantics (``scheduler.py:178-214``).
+            value = _defensive_device_copy(value)
         kind = classify(value, world_size)
         glob_replicated = logical_path in replicated_paths
 
@@ -108,6 +142,9 @@ def prepare_write(
                 logical_path, value, is_async_snapshot=is_async_snapshot
             )
             manifest[logical_path] = entry
+            if is_async_snapshot:
+                for r in reqs:
+                    r.defer_staging = True
             write_reqs.extend(reqs)
             continue
 
@@ -131,6 +168,9 @@ def prepare_write(
                     storage_path, arr, replicated, is_async_snapshot
                 )
             manifest[logical_path] = entry
+            if is_async_snapshot and is_device_value:
+                for r in reqs:
+                    r.defer_staging = True
             write_reqs.extend(reqs)
             continue
 

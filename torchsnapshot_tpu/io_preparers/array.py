@@ -79,16 +79,27 @@ class ArrayBufferStager(BufferStager):
             host = await to_host(arr, executor)()
         else:
             host = np.asarray(arr)
-            if self.is_async_snapshot or not host.flags["C_CONTIGUOUS"]:
-                # Defensive copy: training may mutate host arrays after
-                # async_take returns (reference ``tensor.py:254-278``).
-                host = np.ascontiguousarray(host).copy() if self.is_async_snapshot else np.ascontiguousarray(host)
+            if self.is_async_snapshot:
+                # Host arrays stage *before* async_take returns, but the
+                # staged buffer is a zero-copy view — copy so training can
+                # mutate the live array afterwards (reference
+                # ``tensor.py:254-264``).
+                host = host.copy()
+            elif not host.flags["C_CONTIGUOUS"]:
+                host = np.ascontiguousarray(host)
         if self.entry.serializer == Serializer.RAW:
             return array_as_bytes_view(host)
         return pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
 
     def get_staging_cost_bytes(self) -> int:
         return array_nbytes(self.entry.shape, self.entry.dtype) if self.entry.serializer == Serializer.RAW else _nbytes_of(self.arr)
+
+    def start_d2h_hint(self) -> None:
+        if _is_jax_array(self.arr):
+            try:
+                self.arr.copy_to_host_async()
+            except Exception:  # pragma: no cover - platform-specific hint
+                pass
 
 
 def _nbytes_of(arr: Any) -> int:

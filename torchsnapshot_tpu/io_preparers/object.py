@@ -19,6 +19,10 @@ from ..serialization import Serializer
 
 
 class ObjectBufferStager(BufferStager):
+    """Objects always stage (pickle into a private buffer) before
+    ``async_take`` returns — never ``defer_staging`` — so post-return
+    mutations cannot corrupt the snapshot."""
+
     def __init__(self, obj: Any) -> None:
         self.obj = obj
 

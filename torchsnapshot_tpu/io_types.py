@@ -39,11 +39,26 @@ class BufferStager(abc.ABC):
         """Estimated peak host memory consumed by :meth:`stage_buffer`."""
         ...
 
+    def start_d2h_hint(self) -> None:
+        """Optionally begin the device→host transfer early (non-blocking).
+
+        Called by ``_take_impl`` on deferred-staging requests that survived
+        write partitioning, right before ``async_take`` returns — so DMAs for
+        exactly the bytes this rank will write start overlapping training.
+        Default: no-op (host-resident sources have nothing to transfer).
+        """
+
 
 @dataclass
 class WriteReq:
     path: str
     buffer_stager: BufferStager
+    # Async snapshots may defer this request's staging past async_take's
+    # return (device arrays: immutable + defensively forked, so nothing can
+    # invalidate them). Mutable host state leaves this False and is staged
+    # before async_take returns, under the memory budget — the reference's
+    # capture semantics (``scheduler.py:178-214``).
+    defer_staging: bool = False
 
 
 class BufferConsumer(abc.ABC):

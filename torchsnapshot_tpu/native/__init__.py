@@ -1,0 +1,174 @@
+"""Loader for the native I/O engine (``tss_io.cpp``).
+
+The engine is a single C++ translation unit compiled on first use with the
+host toolchain (``g++ -O2 -shared -fPIC``) and loaded via :mod:`ctypes` —
+ctypes releases the GIL for the duration of each call, so bounce-buffer
+copies and pwrite/pread syscalls overlap the asyncio event loop without a
+C extension module.
+
+Everything degrades gracefully: if no compiler is available, compilation
+fails, or ``TORCHSNAPSHOT_TPU_DISABLE_NATIVE_IO=1`` is set, ``load_native()``
+returns ``None`` and callers (the FS storage plugin) use the pure-Python
+path. The built ``.so`` is cached next to the source (or in
+``~/.cache/torchsnapshot_tpu`` when the package directory is read-only) and
+rebuilt whenever the source is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "tss_io.cpp")
+_LIB_NAME = "libtss_io.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _candidate_lib_paths():
+    yield os.path.join(os.path.dirname(__file__), _LIB_NAME)
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "torchsnapshot_tpu",
+    )
+    yield os.path.join(cache_dir, _LIB_NAME)
+
+
+def _build(out_path: str) -> None:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    # Build to a temp name then rename so concurrent processes never load a
+    # half-written .so.
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(out_path), suffix=".so")
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, out_path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.tss_io_version.restype = ctypes.c_int
+    lib.tss_write_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_uint64,
+    ]
+    lib.tss_write_file.restype = ctypes.c_int
+    lib.tss_read_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_uint64,
+    ]
+    lib.tss_read_file.restype = ctypes.c_int
+    lib.tss_file_size.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.tss_file_size.restype = ctypes.c_int
+    return lib
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Return the native engine, building it if needed; None if unavailable."""
+    from ..utils import knobs
+
+    global _lib, _load_attempted
+    if not knobs.is_native_io_enabled():
+        return None
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        for lib_path in _candidate_lib_paths():
+            try:
+                if not os.path.exists(lib_path) or os.path.getmtime(
+                    lib_path
+                ) < os.path.getmtime(_SRC):
+                    _build(lib_path)
+                _lib = _configure(ctypes.CDLL(lib_path))
+                logger.debug("Loaded native IO engine from %s", lib_path)
+                return _lib
+            except (OSError, subprocess.CalledProcessError) as e:
+                logger.debug("Native IO engine unavailable at %s: %s", lib_path, e)
+                continue
+        logger.info("Native IO engine unavailable; using pure-Python file I/O")
+        return None
+
+
+def _as_uint8_view(buf) -> "memoryview":
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.format not in ("B", "b", "c"):
+        mv = mv.cast("B")
+    return mv
+
+
+def _buf_address(mv: memoryview) -> int:
+    # numpy gives a stable pointer for read-only buffers, which
+    # ctypes.from_buffer refuses.
+    import numpy as np
+
+    return np.frombuffer(mv, dtype=np.uint8).ctypes.data if mv.nbytes else 0
+
+
+def write_file(lib: ctypes.CDLL, path: str, buf, *, direct: bool, chunk_bytes: int) -> None:
+    """Write ``buf`` (any buffer-protocol object) to ``path`` via the engine."""
+    mv = _as_uint8_view(buf)
+    rc = lib.tss_write_file(
+        os.fsencode(path), _buf_address(mv), mv.nbytes, 1 if direct else 0, chunk_bytes
+    )
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+
+
+def read_into(
+    lib: ctypes.CDLL,
+    path: str,
+    dst,
+    *,
+    offset: int = 0,
+    direct: bool = True,
+    chunk_bytes: int = 64 << 20,
+) -> None:
+    """Fill writable buffer ``dst`` from ``path[offset : offset+len(dst)]``."""
+    mv = _as_uint8_view(dst)
+    if mv.readonly:
+        raise ValueError("read_into requires a writable buffer")
+    rc = lib.tss_read_file(
+        os.fsencode(path),
+        _buf_address(mv),
+        offset,
+        mv.nbytes,
+        1 if direct else 0,
+        chunk_bytes,
+    )
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+
+
+def file_size(lib: ctypes.CDLL, path: str) -> int:
+    out = ctypes.c_uint64(0)
+    rc = lib.tss_file_size(os.fsencode(path), ctypes.byref(out))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return out.value

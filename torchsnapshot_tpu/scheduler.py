@@ -15,10 +15,16 @@ credited back when its storage write completes. One over-budget request is
 always admitted when the pipeline is otherwise empty, so a single huge array
 can't deadlock the pipeline (reference ``scheduler.py:268``).
 
-``execute_write_reqs`` returns when **staging** completes — every byte is in
-host RAM — handing back a :class:`PendingIOWork` that drains the remaining
-storage I/O. This is the hinge that makes ``async_take`` overlap storage I/O
-with resumed training (reference ``scheduler.py:178-214``).
+``execute_write_reqs`` returns at the **capture point**: every request whose
+source training could still invalidate (mutable host arrays, objects) has
+been staged into private host buffers under the memory budget — the
+reference's capture semantics (``scheduler.py:178-214``). Requests flagged
+``defer_staging`` (device arrays: immutable, and defensively forked against
+donation by ``io_preparer._defensive_device_copy``) skip that wait; the
+returned :class:`PendingIOWork` drains their device→host transfer plus all
+storage I/O in the background, still under the same budget. For
+device-dominated snapshots — the TPU norm — ``async_take``'s stall is thus
+planning time only, independent of checkpoint size.
 
 The read pipeline mirrors it: storage reads are admitted under a consuming
 budget and buffers are handed to consumers (deserialize + scatter) on the
@@ -76,54 +82,157 @@ class _Budget:
         self.available += n
 
 
-class PendingIOWork:
-    """Storage I/O still in flight after staging completed."""
+class _WritePipeline:
+    """The write-side state machine; resumable so deferred staging
+    (``WriteReq.defer_staging``) can finish on the async-commit background
+    thread."""
 
     def __init__(
         self,
+        write_reqs: List[WriteReq],
         storage: StoragePlugin,
-        budget: _Budget,
-        ready_for_io: Deque[Tuple[str, object]],
-        io_tasks: Dict[asyncio.Task, int],
+        memory_budget_bytes: int,
         rank: int,
-        bytes_staged: int,
-        begin_ts: float,
     ) -> None:
-        self._storage = storage
-        self._budget = budget
-        self._ready_for_io = ready_for_io
-        self._io_tasks = io_tasks
-        self._rank = rank
-        self._bytes_staged = bytes_staged
-        self._begin_ts = begin_ts
+        self.storage = storage
+        self.rank = rank
+        self.begin_ts = time.monotonic()
+        self.budget = _Budget(memory_budget_bytes)
+        # Stage big requests first: they dominate the critical path and admit
+        # small ones into the leftover budget.
+        by_size = sorted(
+            write_reqs, key=lambda r: -r.buffer_stager.get_staging_cost_bytes()
+        )
+        self.pending: Deque[WriteReq] = deque(
+            r for r in by_size if not r.defer_staging
+        )
+        # Staged only after run_until_staged's capture point (see
+        # WriteReq.defer_staging).
+        self.deferred: List[WriteReq] = [r for r in by_size if r.defer_staging]
+        self.staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
+        self.ready_for_io: Deque[Tuple[str, object]] = deque()
+        self.io_tasks: Dict[asyncio.Task, int] = {}
+        self.bytes_staged = 0
+        self.staged_ts: Optional[float] = None
+        self.executor: Optional[ThreadPoolExecutor] = None
+
+    def _dispatch_staging(self) -> None:
+        if self.executor is None:
+            self.executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
+        while self.pending:
+            cost = self.pending[0].buffer_stager.get_staging_cost_bytes()
+            over_budget = cost > self.budget.available
+            pipeline_empty = not self.staging_tasks and not self.io_tasks
+            if over_budget and not pipeline_empty:
+                break
+            req = self.pending.popleft()
+            self.budget.debit(cost)
+            task = asyncio.ensure_future(req.buffer_stager.stage_buffer(self.executor))
+            self.staging_tasks[task] = (req, cost)
 
     def _dispatch_io(self) -> None:
-        while self._ready_for_io and len(self._io_tasks) < _MAX_CONCURRENT_IO:
-            path, buf = self._ready_for_io.popleft()
+        while self.ready_for_io and len(self.io_tasks) < _MAX_CONCURRENT_IO:
+            path, buf = self.ready_for_io.popleft()
             nbytes = memoryview(buf).nbytes
-            task = asyncio.ensure_future(self._storage.write(WriteIO(path=path, buf=buf)))
-            self._io_tasks[task] = nbytes
-
-    async def complete(self) -> None:
-        self._dispatch_io()
-        while self._io_tasks:
-            done, _ = await asyncio.wait(
-                self._io_tasks.keys(), return_when=asyncio.FIRST_COMPLETED
+            task = asyncio.ensure_future(
+                self.storage.write(WriteIO(path=path, buf=buf))
             )
-            for task in done:
-                nbytes = self._io_tasks.pop(task)
+            self.io_tasks[task] = nbytes
+
+    def _reap(self, done) -> None:
+        for task in done:
+            if task in self.staging_tasks:
+                req, cost = self.staging_tasks.pop(task)
+                buf = task.result()
+                nbytes = memoryview(buf).nbytes
+                self.bytes_staged += nbytes
+                # Correct the estimate to the real footprint.
+                self.budget.credit(cost)
+                self.budget.debit(nbytes)
+                self.ready_for_io.append((req.path, buf))
+            else:
+                nbytes = self.io_tasks.pop(task)
                 task.result()  # propagate failures
-                self._budget.credit(nbytes)
+                self.budget.credit(nbytes)
+
+    async def run_until_staged(self) -> None:
+        """Drive the pipeline to the capture point: every *non-deferred*
+        request's bytes are privately held in host RAM. Deferred requests
+        (immutable device-backed data) then join the queue for the
+        background drain."""
+        try:
+            if self.pending:
+                self._dispatch_staging()
+            while self.staging_tasks or self.pending:
+                done, _ = await asyncio.wait(
+                    set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                self._reap(done)
+                self._dispatch_io()
+                self._dispatch_staging()
+        except BaseException:
+            self._shutdown_executor()
+            raise
+        if self.deferred:
+            self.pending.extend(self.deferred)
+            self.deferred = []
+        else:
+            self._mark_staged()
+
+    async def run_to_completion(self) -> None:
+        """Drive the pipeline (staging and I/O) until everything is written."""
+        try:
+            if self.pending or self.staging_tasks:
+                self._dispatch_staging()
             self._dispatch_io()
-        elapsed = time.monotonic() - self._begin_ts
-        if self._bytes_staged:
+            while self.staging_tasks or self.pending or self.io_tasks or self.ready_for_io:
+                done, _ = await asyncio.wait(
+                    set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                self._reap(done)
+                self._dispatch_io()
+                self._dispatch_staging()
+                if not self.staging_tasks and not self.pending:
+                    self._mark_staged()
+        finally:
+            self._shutdown_executor()
+        elapsed = time.monotonic() - self.begin_ts
+        if self.bytes_staged:
             logger.info(
                 "Rank %d wrote %.2f GB in %.2fs (%.2f GB/s)",
-                self._rank,
-                self._bytes_staged / 1e9,
+                self.rank,
+                self.bytes_staged / 1e9,
                 elapsed,
-                self._bytes_staged / 1e9 / max(elapsed, 1e-9),
+                self.bytes_staged / 1e9 / max(elapsed, 1e-9),
             )
+
+    def _mark_staged(self) -> None:
+        if self.staged_ts is None and not self.staging_tasks and not self.pending:
+            self.staged_ts = time.monotonic()
+            logger.info(
+                "Rank %d staged %.2f GB in %.2fs",
+                self.rank,
+                self.bytes_staged / 1e9,
+                self.staged_ts - self.begin_ts,
+            )
+
+    def _shutdown_executor(self) -> None:
+        if self.executor is not None:
+            self.executor.shutdown(wait=False)
+            self.executor = None
+
+
+class PendingIOWork:
+    """Work still in flight after ``execute_write_reqs`` returned: remaining
+    storage I/O, plus staging of any ``defer_staging`` requests."""
+
+    def __init__(self, pipeline: _WritePipeline) -> None:
+        self._pipeline = pipeline
+
+    async def complete(self) -> None:
+        await self._pipeline.run_to_completion()
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
         event_loop.run_until_complete(self.complete())
@@ -135,71 +244,12 @@ async def execute_write_reqs(
     memory_budget_bytes: int,
     rank: int,
 ) -> PendingIOWork:
-    begin_ts = time.monotonic()
-    budget = _Budget(memory_budget_bytes)
-    # Stage big requests first: they dominate the critical path and admit
-    # small ones into the leftover budget.
-    pending: Deque[WriteReq] = deque(
-        sorted(write_reqs, key=lambda r: -r.buffer_stager.get_staging_cost_bytes())
-    )
-    staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
-    ready_for_io: Deque[Tuple[str, object]] = deque()
-    io_tasks: Dict[asyncio.Task, int] = {}
-    bytes_staged = 0
-    executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
-
-    def dispatch_staging() -> None:
-        while pending:
-            cost = pending[0].buffer_stager.get_staging_cost_bytes()
-            over_budget = cost > budget.available
-            pipeline_empty = not staging_tasks and not io_tasks
-            if over_budget and not pipeline_empty:
-                break
-            req = pending.popleft()
-            budget.debit(cost)
-            task = asyncio.ensure_future(req.buffer_stager.stage_buffer(executor))
-            staging_tasks[task] = (req, cost)
-
-    def dispatch_io() -> None:
-        while ready_for_io and len(io_tasks) < _MAX_CONCURRENT_IO:
-            path, buf = ready_for_io.popleft()
-            nbytes = memoryview(buf).nbytes
-            task = asyncio.ensure_future(storage.write(WriteIO(path=path, buf=buf)))
-            io_tasks[task] = nbytes
-
-    try:
-        dispatch_staging()
-        while staging_tasks or pending:
-            done, _ = await asyncio.wait(
-                set(staging_tasks.keys()) | set(io_tasks.keys()),
-                return_when=asyncio.FIRST_COMPLETED,
-            )
-            for task in done:
-                if task in staging_tasks:
-                    req, cost = staging_tasks.pop(task)
-                    buf = task.result()
-                    nbytes = memoryview(buf).nbytes
-                    bytes_staged += nbytes
-                    # Correct the estimate to the real footprint.
-                    budget.credit(cost)
-                    budget.debit(nbytes)
-                    ready_for_io.append((req.path, buf))
-                else:
-                    nbytes = io_tasks.pop(task)
-                    task.result()
-                    budget.credit(nbytes)
-            dispatch_io()
-            dispatch_staging()
-    finally:
-        executor.shutdown(wait=False)
-
-    elapsed = time.monotonic() - begin_ts
-    logger.info(
-        "Rank %d staged %.2f GB in %.2fs", rank, bytes_staged / 1e9, elapsed
-    )
-    return PendingIOWork(
-        storage, budget, ready_for_io, io_tasks, rank, bytes_staged, begin_ts
-    )
+    """Runs to the capture point (all non-deferred requests staged) and
+    returns a :class:`PendingIOWork` that drains the rest (deferred staging +
+    all storage I/O)."""
+    pipeline = _WritePipeline(write_reqs, storage, memory_budget_bytes, rank)
+    await pipeline.run_until_staged()
+    return PendingIOWork(pipeline)
 
 
 def sync_execute_write_reqs(

@@ -149,9 +149,16 @@ class Snapshot:
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
     ) -> "PendingSnapshot":
-        """Returns once all data is captured in host RAM; storage I/O and the
-        atomic commit happen on a background thread. Training may mutate the
-        app state immediately after this returns."""
+        """Returns after planning + forking device buffers (milliseconds);
+        device→host transfer, storage I/O, and the atomic commit all happen on
+        a background thread. Training may replace — or donate — the app
+        state's arrays immediately after this returns.
+
+        This diverges from the reference (whose ``async_take`` must capture
+        all data in host RAM before returning, ``snapshot.py:245-314``)
+        because jax arrays are immutable: an on-device fork detaches the
+        snapshot from subsequent donation, so the train-step stall is
+        planning time only, independent of checkpoint size."""
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(coordinator)
@@ -248,6 +255,14 @@ class Snapshot:
             entries = list(manifest.values())
             _, write_reqs = batch_write_requests(entries, write_reqs)
 
+        if is_async_snapshot and knobs.is_async_eager_d2h_enabled():
+            # Post-partition, so DMAs start only for the bytes THIS rank
+            # will actually write — replicated arrays assigned to other
+            # ranks never touch this host's RAM or PCIe.
+            for req in write_reqs:
+                if req.defer_staging:
+                    req.buffer_stager.start_d2h_hint()
+
         global_manifest = cls._gather_manifest(manifest, coord)
         # None on non-zero ranks: only the committing rank holds the global
         # manifest in memory; everyone else reads it lazily post-commit.
@@ -260,6 +275,11 @@ class Snapshot:
         )
 
         memory_budget = get_process_memory_budget_bytes(coord)
+        # Runs to the capture point: mutable host state is staged into
+        # private buffers; device-array staging is deferred for async
+        # snapshots (immutable + defensively forked), so the async stall is
+        # planning time plus host-state capture only — the background thread
+        # drains device→host→storage under the budget.
         pending_io_work = sync_execute_write_reqs(
             write_reqs=write_reqs,
             storage=storage,

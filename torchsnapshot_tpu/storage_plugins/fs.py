@@ -1,34 +1,65 @@
 """Local/network filesystem storage plugin.
 
-Analogue of the reference's ``storage_plugins/fs.py:19-54``: async file I/O
-with a parent-directory creation cache and ranged reads via seek. Writes go
-through ``aiofiles`` so dozens of in-flight files interleave on one event
-loop; on POSIX the heavy lifting is the thread-pool ``write()`` syscalls,
-which release the GIL.
+Analogue of the reference's ``storage_plugins/fs.py:19-54`` (async file I/O
+with a parent-directory creation cache and ranged reads via seek), with one
+TPU-VM-specific addition: large transfers route through the native O_DIRECT
+engine (``torchsnapshot_tpu/native``). Buffered writeback on TPU-VM hosts is
+throttled far below device bandwidth (~0.12 GB/s vs ~0.62 GB/s direct writes,
+~0.57 vs ~2.0 GB/s cold reads measured on v5e local disk), so checkpoint
+payloads bypass the page cache; small objects (manifests, primitives) keep the
+simple buffered path.
+
+Concurrency: the event loop may have many plugin ops in flight; blocking work
+runs on a private thread pool, and a semaphore caps concurrent O_DIRECT
+streams (disk saturates at ~2; more interfere).
 """
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import os
+import threading
 import uuid
-from typing import Set
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Set
 
 import aiofiles
 
+from .. import native
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..utils import knobs
 
 
 class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
+        self._native = native.load_native() if knobs.is_native_io_enabled() else None
+        self._executor: Optional[ThreadPoolExecutor] = None
+        # threading (not asyncio) semaphore: held inside executor threads, so
+        # it works no matter which event loop drives the plugin.
+        self._direct_sem = threading.Semaphore(knobs.get_direct_io_concurrency())
 
     def _ensure_parent(self, path: str) -> None:
         dir_path = os.path.dirname(path)
         if dir_path and dir_path not in self._dir_cache:
             os.makedirs(dir_path, exist_ok=True)
             self._dir_cache.add(dir_path)
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=max(4, knobs.get_direct_io_concurrency() + 2),
+                thread_name_prefix="tss-fs",
+            )
+        return self._executor
+
+    def _use_native(self, nbytes: int) -> bool:
+        return (
+            self._native is not None
+            and nbytes >= knobs.get_direct_io_threshold_bytes()
+        )
 
     async def write(self, write_io: WriteIO) -> None:
         path = os.path.join(self.root, write_io.path)
@@ -38,8 +69,26 @@ class FSStoragePlugin(StoragePlugin):
         # presence IS the commit marker (object stores give this per-PUT).
         tmp_path = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
         try:
-            async with aiofiles.open(tmp_path, "wb") as f:
-                await f.write(write_io.buf)
+            nbytes = memoryview(write_io.buf).nbytes
+            if self._use_native(nbytes):
+                lib = self._native
+
+                def work() -> None:
+                    with self._direct_sem:
+                        native.write_file(
+                            lib,
+                            tmp_path,
+                            write_io.buf,
+                            direct=True,
+                            chunk_bytes=knobs.get_direct_io_chunk_bytes(),
+                        )
+
+                await asyncio.get_event_loop().run_in_executor(
+                    self._get_executor(), work
+                )
+            else:
+                async with aiofiles.open(tmp_path, "wb") as f:
+                    await f.write(write_io.buf)
             os.replace(tmp_path, path)
         except BaseException:
             with contextlib.suppress(OSError):
@@ -48,16 +97,50 @@ class FSStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
-        async with aiofiles.open(path, "rb") as f:
-            if read_io.byte_range is None:
+        if read_io.byte_range is not None:
+            offset, end = read_io.byte_range
+            nbytes = end - offset
+            if self._use_native(nbytes):
+                read_io.buf.write(await self._native_read(path, offset, nbytes))
+                return
+            async with aiofiles.open(path, "rb") as f:
+                await f.seek(offset)
+                read_io.buf.write(await f.read(nbytes))
+        elif self._native is not None:
+            # Full-object read: the size probe (needed to route + allocate)
+            # runs inside the executor task — never stat() on the event loop.
+            read_io.buf.write(await self._native_read(path, 0, None))
+        else:
+            async with aiofiles.open(path, "rb") as f:
                 read_io.buf.write(await f.read())
-            else:
-                begin, end = read_io.byte_range
-                await f.seek(begin)
-                read_io.buf.write(await f.read(end - begin))
+
+    async def _native_read(
+        self, path: str, offset: int, nbytes: Optional[int]
+    ) -> bytearray:
+        lib = self._native
+
+        def work() -> bytearray:
+            n = native.file_size(lib, path) - offset if nbytes is None else nbytes
+            out = bytearray(n)
+            with self._direct_sem:
+                native.read_into(
+                    lib,
+                    path,
+                    out,
+                    offset=offset,
+                    direct=n >= knobs.get_direct_io_threshold_bytes(),
+                    chunk_bytes=knobs.get_direct_io_chunk_bytes(),
+                )
+            return out
+
+        return await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), work
+        )
 
     async def delete(self, path: str) -> None:
         os.remove(os.path.join(self.root, path))
 
     async def close(self) -> None:
-        pass
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None

@@ -40,23 +40,11 @@ class Box(Generic[T]):
 
 
 def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if isinstance(p, jax.tree_util.DictKey):
-            parts.append(str(p.key))
-        elif isinstance(p, jax.tree_util.SequenceKey):
-            parts.append(str(p.idx))
-        elif isinstance(p, jax.tree_util.GetAttrKey):
-            parts.append(p.name)
-        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
-            parts.append(str(p.key))
-        else:
-            parts.append(str(p))
-    return "/".join(parts) if parts else "value"
+    return "/".join(_path_parts(path))
 
 
 def _path_parts(path) -> list:
-    return [p_str for p_str in (_key_part(p) for p in path)] or ["value"]
+    return [_key_part(p) for p in path] or ["value"]
 
 
 def _key_part(p) -> str:
