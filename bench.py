@@ -103,10 +103,19 @@ def main() -> None:
 
     root = tempfile.mkdtemp(prefix="tss_bench_")
     try:
-        # Warmup: snapshot a small state to absorb one-time costs (imports,
-        # thread pools, native-engine build, jit caches for the layer shapes).
+        # Warmup: absorb one-time costs before any timed run. The native
+        # engine builds with a BLOCKING load (the non-blocking plugin path
+        # would otherwise leave measured runs on buffered I/O while g++ runs
+        # in the background), and the warmup snapshot is an ASYNC take so the
+        # defensive-copy jit for the layer shapes is compiled here, not
+        # inside the headline stall window (sync take never runs that path).
+        from torchsnapshot_tpu import native
+
+        native.load_native()
         warm_params, _ = build_params(0.1, seed=99)
-        Snapshot.take(os.path.join(root, "warm"), {"w": StateDict(**warm_params)})
+        Snapshot.async_take(
+            os.path.join(root, "warm"), {"w": StateDict(**warm_params)}
+        ).wait()
         del warm_params
 
         params, nbytes = build_params(total_gb, seed=0)

@@ -33,6 +33,12 @@ def test_async_take_returns_before_io(tmp_path, monkeypatch) -> None:
     monkeypatch.setattr(
         sp, "url_to_storage_plugin", lambda url: SlowFSStoragePlugin(url)
     )
+    # Untimed warmup: first-use costs (lazy imports, event-loop/plugin
+    # bootstrap) must not count against the staging-latency assertion.
+    Snapshot.async_take(
+        str(tmp_path / "warmup"), {"s": StateDict(w=np.ones(4))}
+    ).wait()
+
     path = str(tmp_path / "ckpt")
     sd = StateDict(v=np.arange(32, dtype=np.float32))
     t0 = time.monotonic()

@@ -127,10 +127,14 @@ def _plugin_roundtrip(plugin: FSStoragePlugin, nbytes: int) -> None:
 
 
 def test_fs_plugin_native_path(tmp_path) -> None:
+    # Build/load the engine BLOCKING so this test exercises the native path
+    # even standalone (the plugin's own _native property is non-blocking and
+    # would return None while a cold-cache background build is running).
+    if native.load_native() is None:
+        pytest.skip("native IO engine unavailable")
     with knobs.override_direct_io_threshold_bytes(1024):
         plugin = FSStoragePlugin(str(tmp_path))
-        if plugin._native is None:
-            pytest.skip("native IO engine unavailable")
+        assert plugin._native is not None
         _plugin_roundtrip(plugin, 1 << 20)
 
 

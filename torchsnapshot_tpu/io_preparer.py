@@ -102,11 +102,26 @@ def _defensive_device_copy(arr: Any) -> Any:
     from .utils import knobs
 
     if knobs.is_async_device_copy_enabled():
+        arr = _jitted_copy(arr.sharding)(arr)
+    return arr
+
+
+def _jitted_copy(sharding):
+    """Cache the jitted copy per sharding so repeat ``async_take`` calls hit
+    jit's C++ fastpath instead of rebuilding a wrapper per leaf per call
+    (O(leaf-count) Python dispatch on the stall-critical path otherwise)."""
+    try:
+        return _JITTED_COPIES[sharding]
+    except KeyError:
         import jax
         import jax.numpy as jnp
 
-        arr = jax.jit(jnp.copy, out_shardings=arr.sharding)(arr)
-    return arr
+        fn = jax.jit(jnp.copy, out_shardings=sharding)
+        _JITTED_COPIES[sharding] = fn
+        return fn
+
+
+_JITTED_COPIES: Dict[Any, Any] = {}
 
 
 def prepare_write(

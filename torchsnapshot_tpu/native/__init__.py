@@ -32,6 +32,7 @@ _LIB_NAME = "libtss_io.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+_bg_build: Optional[threading.Thread] = None
 
 
 def _candidate_lib_paths():
@@ -114,6 +115,48 @@ def load_native() -> Optional[ctypes.CDLL]:
                 continue
         logger.info("Native IO engine unavailable; using pure-Python file I/O")
         return None
+
+
+def load_native_nonblocking() -> Optional[ctypes.CDLL]:
+    """Like :func:`load_native`, but never blocks on compilation.
+
+    If a current ``.so`` is cached on disk this loads it synchronously (a
+    dlopen, milliseconds). Otherwise the g++ build runs on a daemon thread
+    and this returns ``None`` until it completes — callers fall back to
+    buffered I/O in the meantime, keeping first-``take`` latency free of the
+    multi-second compile.
+    """
+    global _lib, _load_attempted, _bg_build
+    from ..utils import knobs
+
+    if not knobs.is_native_io_enabled():
+        return None
+    if _load_attempted:
+        return _lib
+    for lib_path in _candidate_lib_paths():
+        try:
+            if os.path.exists(lib_path) and os.path.getmtime(
+                lib_path
+            ) >= os.path.getmtime(_SRC):
+                # dlopen THIS candidate directly: delegating to load_native()
+                # would re-walk the candidates in its own order and could hit
+                # a missing earlier path and compile synchronously.
+                with _lock:
+                    if not _load_attempted:
+                        _lib = _configure(ctypes.CDLL(lib_path))
+                        _load_attempted = True
+                    return _lib
+        except OSError:
+            continue
+    with _lock:
+        if _load_attempted:
+            return _lib
+        if _bg_build is None or not _bg_build.is_alive():
+            _bg_build = threading.Thread(
+                target=load_native, daemon=True, name="tss-native-build"
+            )
+            _bg_build.start()
+    return None
 
 
 def _as_uint8_view(buf) -> "memoryview":

@@ -188,7 +188,9 @@ int tss_read_file(const char* path, void* dst, uint64_t offset, uint64_t nbytes,
       }
       uint64_t got = static_cast<uint64_t>(r);
       if (got <= lead) {
-        rc = -EIO;
+        // No forward progress under O_DIRECT (short read at an unaligned
+        // boundary — seen on NFS/FUSE). Mirror the write path: finish via
+        // the buffered fallback below instead of failing the restore.
         break;
       }
       uint64_t usable = std::min(got - lead, n);

@@ -35,11 +35,17 @@ class FSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
-        self._native = native.load_native() if knobs.is_native_io_enabled() else None
         self._executor: Optional[ThreadPoolExecutor] = None
         # threading (not asyncio) semaphore: held inside executor threads, so
         # it works no matter which event loop drives the plugin.
         self._direct_sem = threading.Semaphore(knobs.get_direct_io_concurrency())
+
+    @property
+    def _native(self):
+        # Non-blocking: a cached .so dlopens in milliseconds; a missing one
+        # compiles on a daemon thread while writes take the buffered path —
+        # the first take() never stalls behind g++.
+        return native.load_native_nonblocking()
 
     def _ensure_parent(self, path: str) -> None:
         dir_path = os.path.dirname(path)
