@@ -1,0 +1,69 @@
+"""Row-sharded embedding checkpoint + elastic reshard example
+(reference ``examples/torchrec/main.py``: row-wise sharded embedding bags
+saved with one world size, restored with another).
+
+TPU-native version: the table is a single global ``jax.Array`` row-sharded
+over a mesh axis; saving writes each process's shards, and restoring under a
+*different* mesh factorization is an overlap computation on byte ranges — no
+inter-device traffic.
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/embedding_example.py
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    devices = jax.devices()
+    n = len(devices)
+    rows, dim = 4096, 64
+
+    # --- "training" under an n-way row sharding -----------------------------
+    mesh = Mesh(np.array(devices), ("shard",))
+    table = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (rows, dim), jnp.float32),
+        NamedSharding(mesh, P("shard")),
+    )
+    app_state = {"embeddings": StateDict(table=table)}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "ckpt")
+        Snapshot.take(path, app_state)
+        print(f"saved {table.nbytes / 1e6:.1f} MB row-sharded {n}-way")
+
+        # --- elastic restore: fewer shards, extra axis replicated -----------
+        half = max(1, n // 2)
+        mesh_b = Mesh(np.array(devices).reshape(half, n // half), ("shard", "rep"))
+        target = jax.device_put(
+            jnp.zeros((rows, dim), jnp.float32),
+            NamedSharding(mesh_b, P("shard", None)),
+        )
+        restored_state = {"embeddings": StateDict(table=target)}
+        Snapshot(path).restore(restored_state)
+        restored = restored_state["embeddings"]["table"]
+        assert restored.sharding.is_equivalent_to(target.sharding, ndim=2)
+        np.testing.assert_array_equal(np.asarray(restored), np.asarray(table))
+        print(f"restored bit-exactly under a {half}-way sharding "
+              f"(mesh {dict(mesh_b.shape)})")
+
+        # --- random access: fetch a row range without the full table --------
+        sub = Snapshot(path).read_object("0/embeddings/table")
+        np.testing.assert_array_equal(np.asarray(sub), np.asarray(table))
+        print("read_object round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,7 @@ def test_async_take_returns_before_io(tmp_path, monkeypatch) -> None:
 def test_async_take_survives_donation(tmp_path) -> None:
     """Training may donate (invalidate) the checkpointed jax arrays right
     after ``async_take`` returns; the on-device defensive fork
-    (``io_preparer._defensive_device_copy``) keeps the capture intact."""
+    (``io_preparer._defensive_device_copies``) keeps the capture intact."""
     import jax.numpy as jnp
 
     x = jnp.arange(1024, dtype=jnp.float32)
@@ -138,3 +138,38 @@ def test_sync_take_failure_never_commits(tmp_path, monkeypatch) -> None:
     with pytest.raises(RuntimeError, match="injected"):
         Snapshot.take(path, {"s": StateDict(v=np.ones(4))})
     assert not os.path.exists(os.path.join(path, ".snapshot_metadata"))
+
+
+def test_async_take_mixed_device_assignments(tmp_path) -> None:
+    """Leaves with different device assignments (mesh-sharded params next to
+    a counter committed to one device) must each be forked in their own
+    batched-copy program — one jit call over all of them would raise
+    'incompatible devices for jitted computation'."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("x",))
+    sharded = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, P("x")),
+    )
+    single = jax.device_put(jnp.int32(7), devices[0])
+    replicated_host = np.float64(2.5)
+
+    path = str(tmp_path / "ckpt")
+    pending = Snapshot.async_take(
+        path, {"s": StateDict(w=sharded, step=single, lr=replicated_host)}
+    )
+    snap = pending.wait()
+
+    tgt = StateDict(
+        w=jax.device_put(jnp.zeros((8, 8), jnp.float32), NamedSharding(mesh, P("x"))),
+        step=jax.device_put(jnp.int32(0), devices[0]),
+        lr=np.float64(0.0),
+    )
+    snap.restore({"s": tgt})
+    assert np.array_equal(np.asarray(tgt["w"]), np.arange(64, dtype=np.float32).reshape(8, 8))
+    assert int(tgt["step"]) == 7
+    assert float(tgt["lr"]) == 2.5

@@ -1,0 +1,205 @@
+"""GCS plugin tests (reference ``tests/test_gcs_storage_plugin.py``).
+
+Unit tests run against a fake ``google.cloud.storage`` SDK injected into
+``sys.modules`` (the reference's fake-backend pattern); the live integration
+test is env-var gated and skips when no bucket is configured.
+"""
+
+import asyncio
+import os
+import sys
+import types
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+
+
+def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
+    class FakeBlob:
+        def __init__(self, name: str) -> None:
+            self._name = name
+
+        def upload_from_file(self, fileobj, size=None, rewind=False) -> None:
+            if rewind:
+                fileobj.seek(0)
+            data = fileobj.read(size) if size is not None else fileobj.read()
+            blobs[self._name] = bytes(data)
+
+        def download_as_bytes(self, start=None, end=None) -> bytes:
+            n_fail = fail_reads.get(self._name, 0)
+            if n_fail:
+                fail_reads[self._name] = n_fail - 1
+                raise ConnectionError("simulated transient failure")
+            data = blobs[self._name]
+            if start is None:
+                return data
+            return data[start : end + 1]  # GCS ranges are inclusive
+
+        def delete(self) -> None:
+            del blobs[self._name]
+
+    class FakeBucket:
+        def __init__(self, name: str) -> None:
+            self.name = name
+
+        def blob(self, path: str) -> FakeBlob:
+            return FakeBlob(path)
+
+    class FakeClient:
+        def bucket(self, name: str) -> FakeBucket:
+            return FakeBucket(name)
+
+    storage_mod = types.ModuleType("google.cloud.storage")
+    storage_mod.Client = FakeClient
+    cloud_mod = types.ModuleType("google.cloud")
+    cloud_mod.storage = storage_mod
+    google_mod = types.ModuleType("google")
+    google_mod.cloud = cloud_mod
+    monkeypatch.setitem(sys.modules, "google", google_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", storage_mod)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture
+def fake_gcs(monkeypatch):
+    blobs: dict = {}
+    fail_reads: dict = {}
+    _install_fake_gcs(monkeypatch, blobs, fail_reads)
+    # Keep retry backoff out of the test's wall clock.
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+
+    monkeypatch.setattr(gcs_mod, "_BASE_BACKOFF_S", 0.001)
+    return blobs, fail_reads
+
+
+def test_write_read_roundtrip(fake_gcs) -> None:
+    blobs, _ = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket/pre/fix")
+    payload = bytes(range(256)) * 8
+
+    async def go():
+        await plugin.write(WriteIO(path="a/blob", buf=memoryview(payload)))
+        rio = ReadIO(path="a/blob")
+        await plugin.read(rio)
+        await plugin.close()
+        return rio.buf.getvalue()
+
+    assert _run(go()) == payload
+    assert set(blobs) == {"pre/fix/a/blob"}  # bucket prefix applied
+
+
+def test_ranged_read_inclusive_end_translation(fake_gcs) -> None:
+    _, _ = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+    payload = bytes(range(256))
+
+    async def go():
+        await plugin.write(WriteIO(path="blob", buf=payload))
+        out = []
+        for lo, hi in [(0, 16), (100, 200), (255, 256)]:
+            rio = ReadIO(path="blob", byte_range=(lo, hi))
+            await plugin.read(rio)
+            out.append((lo, hi, rio.buf.getvalue()))
+        await plugin.close()
+        return out
+
+    # Half-open [lo, hi) byte ranges must map to GCS's inclusive ends.
+    for lo, hi, got in _run(go()):
+        assert got == payload[lo:hi], (lo, hi)
+
+
+def test_transient_errors_retried(fake_gcs) -> None:
+    blobs, fail_reads = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+    blobs["blob"] = b"payload"
+    fail_reads["blob"] = 2  # fail twice, then succeed
+
+    async def go():
+        rio = ReadIO(path="blob")
+        await plugin.read(rio)
+        await plugin.close()
+        return rio.buf.getvalue()
+
+    assert _run(go()) == b"payload"
+    assert fail_reads["blob"] == 0
+
+
+def test_nontransient_error_propagates(fake_gcs) -> None:
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+
+    async def go():
+        rio = ReadIO(path="missing")  # KeyError from the fake: not transient
+        await plugin.read(rio)
+
+    with pytest.raises(KeyError):
+        _run(go())
+    _run(plugin.close())
+
+
+def test_delete(fake_gcs) -> None:
+    blobs, _ = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+
+    async def go():
+        await plugin.write(WriteIO(path="doomed", buf=b"x"))
+        await plugin.delete("doomed")
+        await plugin.close()
+
+    _run(go())
+    assert blobs == {}
+
+
+def test_missing_sdk_raises_clear_error(monkeypatch) -> None:
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_gcs(name, *args, **kwargs):
+        if name.startswith("google"):
+            raise ImportError(name)
+        return real_import(name, *args, **kwargs)
+
+    for mod in [m for m in sys.modules if m.startswith("google")]:
+        monkeypatch.delitem(sys.modules, mod, raising=False)
+    monkeypatch.setattr(builtins, "__import__", no_gcs)
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    with pytest.raises(RuntimeError, match="google-cloud-storage"):
+        GCSStoragePlugin(root="bucket")
+
+
+@pytest.mark.skipif(
+    "TORCHSNAPSHOT_TPU_GCS_TEST_BUCKET" not in os.environ,
+    reason="live GCS integration is env-var gated",
+)
+def test_live_snapshot_roundtrip(tmp_path) -> None:
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    bucket = os.environ["TORCHSNAPSHOT_TPU_GCS_TEST_BUCKET"]
+    path = f"gs://{bucket}/torchsnapshot_tpu_ci/{os.getpid()}"
+    arr = np.arange(1024, dtype=np.float32)
+    Snapshot.take(path, {"s": StateDict(arr=arr)})
+    out = {"s": StateDict(arr=np.zeros(1024, dtype=np.float32))}
+    Snapshot(path).restore(out)
+    assert np.array_equal(out["s"]["arr"], arr)

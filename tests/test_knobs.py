@@ -1,0 +1,69 @@
+"""Env-var knob defaults + context-manager overrides (reference ``knobs.py:21-98``)."""
+
+import os
+
+from torchsnapshot_tpu.utils import knobs
+
+
+def test_defaults() -> None:
+    assert knobs.get_max_chunk_size_bytes() == 512 * 1024 * 1024
+    assert knobs.get_max_shard_size_bytes() == 512 * 1024 * 1024
+    assert knobs.get_slab_size_threshold_bytes() == 128 * 1024 * 1024
+    assert knobs.is_batching_enabled() is False
+    assert knobs.get_memory_budget_override_bytes() is None
+    assert knobs.is_async_device_copy_enabled() is True
+    assert knobs.is_async_eager_d2h_enabled() is True
+
+
+def test_override_restores_prior_value() -> None:
+    os.environ[knobs._ENV_MAX_CHUNK] = "1234"
+    try:
+        assert knobs.get_max_chunk_size_bytes() == 1234
+        with knobs.override_max_chunk_size_bytes(99):
+            assert knobs.get_max_chunk_size_bytes() == 99
+        assert knobs.get_max_chunk_size_bytes() == 1234
+    finally:
+        del os.environ[knobs._ENV_MAX_CHUNK]
+
+
+def test_override_restores_absence() -> None:
+    assert knobs._ENV_MAX_SHARD not in os.environ
+    with knobs.override_max_shard_size_bytes(77):
+        assert knobs.get_max_shard_size_bytes() == 77
+        assert os.environ[knobs._ENV_MAX_SHARD] == "77"
+    assert knobs._ENV_MAX_SHARD not in os.environ
+    assert knobs.get_max_shard_size_bytes() == 512 * 1024 * 1024
+
+
+def test_batching_toggle_parsing() -> None:
+    with knobs.override_batching_enabled(True):
+        assert knobs.is_batching_enabled()
+        with knobs.override_batching_enabled(False):
+            assert not knobs.is_batching_enabled()
+        assert knobs.is_batching_enabled()
+
+
+def test_memory_budget_override() -> None:
+    with knobs.override_memory_budget_bytes(10_000_000):
+        assert knobs.get_memory_budget_override_bytes() == 10_000_000
+
+    from torchsnapshot_tpu.scheduler import get_process_memory_budget_bytes
+
+    with knobs.override_memory_budget_bytes(123_456):
+        assert get_process_memory_budget_bytes(None) == 123_456
+
+
+def test_barrier_timeout_override() -> None:
+    assert knobs.get_barrier_timeout_s() == 1800.0
+    with knobs.override_barrier_timeout_s(2.5):
+        assert knobs.get_barrier_timeout_s() == 2.5
+
+
+def test_exception_inside_override_still_restores() -> None:
+    try:
+        with knobs.override_slab_size_threshold_bytes(5):
+            assert knobs.get_slab_size_threshold_bytes() == 5
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert knobs.get_slab_size_threshold_bytes() == 128 * 1024 * 1024

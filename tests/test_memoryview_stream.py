@@ -1,0 +1,76 @@
+"""MemoryviewStream behavior (reference ``tests/test_memoryview_stream.py``)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.memoryview_stream import MemoryviewStream
+
+
+def test_sequential_read() -> None:
+    s = MemoryviewStream(memoryview(b"hello world"))
+    assert s.read(5) == b"hello"
+    assert s.read(1) == b" "
+    assert s.read() == b"world"
+    assert s.read() == b""
+
+
+def test_read_all_default_and_none() -> None:
+    s = MemoryviewStream(memoryview(b"abc"))
+    assert s.read() == b"abc"
+    s.seek(0)
+    assert s.read(None) == b"abc"
+
+
+def test_seek_tell_whence() -> None:
+    s = MemoryviewStream(memoryview(b"0123456789"))
+    assert s.seek(4) == 4
+    assert s.tell() == 4
+    assert s.read(2) == b"45"
+    assert s.seek(-3, io.SEEK_CUR) == 3
+    assert s.seek(-2, io.SEEK_END) == 8
+    assert s.read() == b"89"
+    with pytest.raises(ValueError):
+        s.seek(-1)
+    with pytest.raises(ValueError):
+        s.seek(0, 42)
+
+
+def test_seek_past_end_reads_empty() -> None:
+    s = MemoryviewStream(memoryview(b"abc"))
+    s.seek(100)
+    assert s.read() == b""
+
+
+def test_readinto() -> None:
+    s = MemoryviewStream(memoryview(b"abcdef"))
+    buf = bytearray(4)
+    assert s.readinto(buf) == 4
+    assert bytes(buf) == b"abcd"
+    assert s.readinto(buf) == 2
+    assert bytes(buf[:2]) == b"ef"
+    assert s.readinto(buf) == 0
+
+
+def test_non_byte_format_is_cast() -> None:
+    # Staged buffers are often float/bf16 memoryviews; the stream must expose
+    # raw bytes regardless of the source format.
+    arr = np.arange(4, dtype=np.float32)
+    s = MemoryviewStream(memoryview(arr))
+    data = s.read()
+    assert data == arr.tobytes()
+
+
+def test_readable_seekable_close() -> None:
+    s = MemoryviewStream(memoryview(b"abc"))
+    assert s.readable() and s.seekable()
+    s.close()
+    assert s.closed
+
+
+def test_interop_with_stdlib_readers() -> None:
+    # io.BufferedReader over the raw stream — the way SDKs consume it.
+    payload = bytes(range(256)) * 64
+    reader = io.BufferedReader(MemoryviewStream(memoryview(payload)))
+    assert reader.read() == payload

@@ -1,0 +1,131 @@
+"""Replication-glob semantics (reference ``tests/test_replication_glob.py`` and
+``tests/test_ddp_replication_glob.py``): glob -> replicated-path tables, and
+rank-asymmetric globs being dropped during coalescing."""
+
+import logging
+
+import pytest
+
+from torchsnapshot_tpu.snapshot import Snapshot
+
+
+class _FakeCoordinator:
+    """Minimal coordinator: each 'rank' contributes one element per gather."""
+
+    def __init__(self, rank: int, world_size: int, gathered_by_call):
+        self._rank = rank
+        self._world = world_size
+        # list of lists: consecutive all_gather_object results to hand out
+        self._gathered = list(gathered_by_call)
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world
+
+    def all_gather_object(self, obj):
+        return self._gathered.pop(0)
+
+    def barrier(self) -> None:
+        pass
+
+
+PATHS = {
+    "model/layer1/weight",
+    "model/layer1/bias",
+    "model/layer2/weight",
+    "optim/state/0/exp_avg",
+    "progress/epoch",
+}
+
+
+@pytest.mark.parametrize(
+    "globs, expected",
+    [
+        ([], set()),
+        (["**"], PATHS),
+        (["model/**"], {p for p in PATHS if p.startswith("model/")}),
+        (["model/layer1/*"], {"model/layer1/weight", "model/layer1/bias"}),
+        (["*/epoch"], {"progress/epoch"}),
+        (["nomatch/**"], set()),
+        (
+            ["model/*/weight", "optim/**"],
+            {
+                "model/layer1/weight",
+                "model/layer2/weight",
+                "optim/state/0/exp_avg",
+            },
+        ),
+    ],
+)
+def test_glob_matching_table(globs, expected) -> None:
+    assert Snapshot._match_replicated_paths(set(PATHS), globs) == expected
+
+
+def test_single_process_passthrough() -> None:
+    coord = _FakeCoordinator(0, 1, [])
+    path, globs = Snapshot._coalesce_path_and_replicated(
+        "/tmp/snap", coord, ["b/**", "a/**", "a/**"]
+    )
+    assert path == "/tmp/snap"
+    assert globs == ["a/**", "b/**"]  # deduped + sorted
+
+
+def test_rank_asymmetric_globs_dropped(caplog) -> None:
+    # Rank 0 passes {a,b}; rank 1 passes {b,c} -> only the intersection {b}
+    # is honored (reference snapshot.py:815-825).
+    coord = _FakeCoordinator(
+        0,
+        2,
+        [
+            ["/tmp/snap", "/tmp/snap"],  # path gather
+            [["a/**", "b/**"], ["b/**", "c/**"]],  # glob gather
+        ],
+    )
+    with caplog.at_level(logging.WARNING):
+        path, globs = Snapshot._coalesce_path_and_replicated(
+            "/tmp/snap", coord, ["a/**", "b/**"]
+        )
+    assert path == "/tmp/snap"
+    assert globs == ["b/**"]
+    assert any("rank-asymmetric" in r.message.lower() for r in caplog.records)
+
+
+def test_rank_divergent_path_uses_rank0(caplog) -> None:
+    coord = _FakeCoordinator(
+        1,
+        2,
+        [
+            ["/snap/rank0", "/snap/rank1"],
+            [[], []],
+        ],
+    )
+    with caplog.at_level(logging.WARNING):
+        path, globs = Snapshot._coalesce_path_and_replicated(
+            "/snap/rank1", coord, []
+        )
+    assert path == "/snap/rank0"
+    assert globs == []
+    assert any("divergent" in r.message.lower() for r in caplog.records)
+
+
+def test_glob_replicated_numpy_saved_under_replicated_prefix(tmp_path) -> None:
+    """np.ndarray leaves are replicated only via user glob; the storage path
+    moves from ``<rank>/`` to ``replicated/`` (reference io_preparer.py:51-57)."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot as PublicSnapshot
+    from torchsnapshot_tpu.state_dict import StateDict
+
+    app_state = {"model": StateDict(w=np.arange(16, dtype=np.float32))}
+    snap = PublicSnapshot.take(str(tmp_path / "snap"), app_state, replicated=["model/**"])
+    manifest = snap.get_manifest()
+    entry = manifest["0/model/w"]
+    assert entry.replicated
+    assert entry.location.startswith("replicated/")
+
+    # And restores bit-exactly.
+    target = {"model": StateDict(w=np.zeros(16, dtype=np.float32))}
+    snap.restore(target)
+    assert np.array_equal(target["model"]["w"], np.arange(16, dtype=np.float32))

@@ -1,0 +1,38 @@
+"""RSS-delta sampler (reference ``tests/test_rss_profiler.py``)."""
+
+import time
+
+import numpy as np
+
+from torchsnapshot_tpu.utils.rss_profiler import measure_rss_deltas
+
+
+def test_measures_allocation() -> None:
+    deltas = []
+    with measure_rss_deltas(rss_deltas=deltas, interval_ms=10.0):
+        # Allocate and touch ~64 MB so it lands in RSS.
+        arr = np.ones(64 * 1024 * 1024 // 8, dtype=np.float64)
+        arr += 1.0
+        time.sleep(0.1)
+    assert deltas, "sampler produced no samples"
+    # Allow generous slack for allocator behavior; the signal is ~64 MB.
+    assert max(deltas) > 32 * 1024 * 1024
+    del arr
+
+
+def test_final_sample_appended_even_without_sleep() -> None:
+    deltas = []
+    with measure_rss_deltas(rss_deltas=deltas, interval_ms=10_000.0):
+        pass  # exit before the first periodic sample fires
+    assert len(deltas) >= 1  # the context manager appends a closing sample
+
+
+def test_deltas_are_relative_to_entry_baseline() -> None:
+    ballast = np.ones(32 * 1024 * 1024 // 8, dtype=np.float64)
+    ballast += 1.0
+    deltas = []
+    with measure_rss_deltas(rss_deltas=deltas, interval_ms=10.0):
+        time.sleep(0.05)
+    # Pre-existing allocations must not count toward the delta.
+    assert max(deltas) < 16 * 1024 * 1024
+    del ballast

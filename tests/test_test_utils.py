@@ -1,0 +1,86 @@
+"""Watch the watchmen (reference ``tests/test_test_utils.py``): the shipped
+test helpers must themselves be correct, or every other test is suspect."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.serialization import SUPPORTED_DTYPES
+from torchsnapshot_tpu.test_utils import (
+    assert_state_dict_eq,
+    check_state_dict_eq,
+    rand_array,
+)
+
+
+def test_equal_nested_state_dicts() -> None:
+    import jax.numpy as jnp
+
+    a = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "nested": {"b": jnp.ones((4,)), "s": "str", "i": 3},
+        "lst": [1, np.float64(2.5), (3, 4)],
+    }
+    b = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "nested": {"b": jnp.ones((4,)), "s": "str", "i": 3},
+        "lst": [1, np.float64(2.5), (3, 4)],
+    }
+    assert check_state_dict_eq(a, b)
+    assert_state_dict_eq(a, b)
+
+
+@pytest.mark.parametrize(
+    "a, b",
+    [
+        ({"k": np.ones(3)}, {"k": np.ones(4)}),  # shape
+        ({"k": np.ones(3, np.float32)}, {"k": np.ones(3, np.float64)}),  # dtype
+        ({"k": np.ones(3)}, {"k": np.zeros(3)}),  # values
+        ({"k": 1}, {"j": 1}),  # keys
+        ({"k": [1, 2]}, {"k": [1, 2, 3]}),  # list length
+        ({"k": np.ones(3)}, {"k": "ones"}),  # array vs non-array
+        ({"k": 1}, {"k": 2}),  # scalars
+    ],
+)
+def test_unequal_state_dicts(a, b) -> None:
+    assert not check_state_dict_eq(a, b)
+    with pytest.raises(AssertionError):
+        assert_state_dict_eq(a, b)
+
+
+def test_nan_bitwise_equality() -> None:
+    # exact=True must treat identical NaN payloads as equal (np.array_equal
+    # alone would not) and different payloads as different.
+    a = np.array([np.nan, 1.0], dtype=np.float64)
+    b = a.copy()
+    assert check_state_dict_eq({"k": a}, {"k": b}, exact=True)
+    # Flip one mantissa bit inside the NaN.
+    c = a.copy()
+    c_view = c.view(np.uint64)
+    c_view[0] ^= 1
+    assert not check_state_dict_eq({"k": a}, {"k": c}, exact=True)
+    # allclose mode: NaNs never compare equal.
+    assert not check_state_dict_eq({"k": a}, {"k": b}, exact=False)
+
+
+def test_inexact_mode_tolerates_rounding() -> None:
+    a = {"k": np.array([1.0, 2.0])}
+    b = {"k": np.array([1.0 + 1e-12, 2.0])}
+    assert check_state_dict_eq(a, b, exact=False)
+    assert not check_state_dict_eq(a, b, exact=True)
+
+
+@pytest.mark.parametrize("dtype", sorted(SUPPORTED_DTYPES.keys()))
+def test_rand_array_all_dtypes(dtype) -> None:
+    arr = rand_array((4, 5), dtype, seed=0)
+    assert arr.shape == (4, 5)
+    assert arr.dtype == SUPPORTED_DTYPES[dtype]
+    # Deterministic under a fixed seed.
+    again = rand_array((4, 5), dtype, seed=0)
+    assert np.array_equal(
+        arr.reshape(-1).view(np.uint8), again.reshape(-1).view(np.uint8)
+    )
+
+
+def test_rand_array_is_nonconstant() -> None:
+    arr = rand_array((64,), "float32", seed=1)
+    assert len(np.unique(arr)) > 1

@@ -83,8 +83,8 @@ def classify(value: Any, world_size: int) -> str:
     return "object"
 
 
-def _defensive_device_copy(arr: Any) -> Any:
-    """Fork a jax array's device buffers for async capture.
+def _defensive_device_copies(arrs: List[Any]) -> List[Any]:
+    """Fork jax arrays' device buffers for async capture — in ONE program.
 
     TPU-native replacement for the reference's defensive *host* copies
     (``io_preparers/tensor.py:254-278``): torch must capture mutable tensors
@@ -94,34 +94,67 @@ def _defensive_device_copy(arr: Any) -> Any:
     copy (dispatched asynchronously — microseconds on the host timeline,
     HBM-bandwidth on the device) detaches the snapshot from donation.
 
-    The copy runs under an explicit ``jit`` pinned to the array's own
-    sharding: eager ``jnp.copy`` would raise on non-fully-addressable
-    (multi-process) global arrays, and every rank reaches this point in the
-    same gathered-key order, so the SPMD requirement holds.
+    All leaves are copied in a single jitted call: per-leaf ``jit(jnp.copy)``
+    would compile one XLA program per (sharding, shape) — tens of seconds of
+    cold-start stall on a real transformer state — whereas one program
+    compiles once per state *structure* and dispatches once per take.
+
+    The copy runs under ``jit`` pinned to each array's own sharding: eager
+    ``jnp.copy`` would raise on non-fully-addressable (multi-process) global
+    arrays, and every rank reaches this point in the same gathered-key
+    order, so the SPMD requirement holds. ``out_shardings`` is explicit —
+    downstream routing (``classify``, shard enumeration) reads the copy's
+    sharding, so propagation must not be allowed to pick a different one.
+
+    One jitted computation requires all operands to share a device
+    assignment, so leaves are grouped by assignment first (params on the
+    full mesh vs. a step counter committed to one device vs. host-offloaded
+    state); each group compiles and dispatches once.
     """
-    from .utils import knobs
+    groups: Dict[Any, List[int]] = {}
+    for i, a in enumerate(arrs):
+        groups.setdefault(_device_assignment_key(a.sharding), []).append(i)
+    out: List[Any] = [None] * len(arrs)
+    for indices in groups.values():
+        group = [arrs[i] for i in indices]
+        copies = _batch_copy_fn(tuple(a.sharding for a in group))(group)
+        for i, c in zip(indices, copies):
+            out[i] = c
+    return out
 
-    if knobs.is_async_device_copy_enabled():
-        arr = _jitted_copy(arr.sharding)(arr)
-    return arr
 
-
-def _jitted_copy(sharding):
-    """Cache the jitted copy per sharding so repeat ``async_take`` calls hit
-    jit's C++ fastpath instead of rebuilding a wrapper per leaf per call
-    (O(leaf-count) Python dispatch on the stall-critical path otherwise)."""
+def _device_assignment_key(sharding) -> Any:
     try:
-        return _JITTED_COPIES[sharding]
+        return tuple(d.id for d in sharding._device_assignment)
+    except AttributeError:
+        # Not part of jax's public API. Fall back to one group per distinct
+        # sharding: equal shardings trivially share an assignment, while a
+        # set-based key would merge same-device-set/different-order
+        # assignments into one jit call, which jax rejects. Costs batching
+        # granularity, never correctness.
+        return sharding
+
+
+def _batch_copy_fn(shardings: Tuple[Any, ...]):
+    try:
+        return _BATCH_COPIES[shardings]
     except KeyError:
         import jax
         import jax.numpy as jnp
 
-        fn = jax.jit(jnp.copy, out_shardings=sharding)
-        _JITTED_COPIES[sharding] = fn
+        fn = jax.jit(
+            lambda xs: [jnp.copy(x) for x in xs], out_shardings=list(shardings)
+        )
+        # jax.jit caches compiled executables internally; this dict only
+        # avoids rebuilding the Python wrapper. Bound it so long-running
+        # jobs with evolving state structures can't grow it without limit.
+        if len(_BATCH_COPIES) >= 16:
+            _BATCH_COPIES.pop(next(iter(_BATCH_COPIES)))
+        _BATCH_COPIES[shardings] = fn
         return fn
 
 
-_JITTED_COPIES: Dict[Any, Any] = {}
+_BATCH_COPIES: Dict[Any, Any] = {}
 
 
 def prepare_write(
@@ -134,15 +167,22 @@ def prepare_write(
     """Plan all writes for this rank's flattened state (no data moves yet)."""
     manifest: Manifest = {}
     write_reqs: List[WriteReq] = []
+    if is_async_snapshot:
+        # Device arrays are immutable; fork them against donation and defer
+        # their staging past async_take's return. Mutable host state keeps
+        # defer_staging=False and is captured (staged under the budget)
+        # before async_take returns — the reference's semantics
+        # (``scheduler.py:178-214``).
+        from .utils import knobs
+
+        device_paths = [p for p, v in flattened.items() if _is_jax_array(v)]
+        if device_paths and knobs.is_async_device_copy_enabled():
+            copies = _defensive_device_copies([flattened[p] for p in device_paths])
+            flattened = dict(flattened)
+            flattened.update(zip(device_paths, copies))
+    device_paths_set = {p for p, v in flattened.items() if _is_jax_array(v)}
     for logical_path, value in flattened.items():
-        is_device_value = _is_jax_array(value)
-        if is_async_snapshot and is_device_value:
-            # Device arrays are immutable; fork them against donation and
-            # defer their staging past async_take's return. Mutable host
-            # state keeps defer_staging=False and is captured (staged under
-            # the budget) before async_take returns — the reference's
-            # semantics (``scheduler.py:178-214``).
-            value = _defensive_device_copy(value)
+        is_device_value = logical_path in device_paths_set
         kind = classify(value, world_size)
         glob_replicated = logical_path in replicated_paths
 

@@ -29,7 +29,11 @@ logger = logging.getLogger(__name__)
 _SRC = os.path.join(os.path.dirname(__file__), "tss_io.cpp")
 _LIB_NAME = "libtss_io.so"
 
+# _lock guards only the published (_lib, _load_attempted) state and is never
+# held across a compile; _build_lock serializes the (multi-second) g++ build
+# so nonblocking callers checking state don't queue behind it.
 _lock = threading.Lock()
+_build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 _bg_build: Optional[threading.Thread] = None
@@ -90,31 +94,64 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _load_cached() -> Optional[ctypes.CDLL]:
+    """dlopen an up-to-date cached ``.so`` if one exists (no build)."""
+    for lib_path in _candidate_lib_paths():
+        try:
+            if os.path.exists(lib_path) and os.path.getmtime(
+                lib_path
+            ) >= os.path.getmtime(_SRC):
+                lib = _configure(ctypes.CDLL(lib_path))
+                logger.debug("Loaded native IO engine from %s", lib_path)
+                return lib
+        except OSError as e:
+            logger.debug("Native IO engine unavailable at %s: %s", lib_path, e)
+            continue
+    return None
+
+
+def _publish(lib: Optional[ctypes.CDLL]) -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lock:
+        if not _load_attempted:
+            _lib = lib
+            _load_attempted = True
+        return _lib
+
+
 def load_native() -> Optional[ctypes.CDLL]:
     """Return the native engine, building it if needed; None if unavailable."""
     from ..utils import knobs
 
-    global _lib, _load_attempted
     if not knobs.is_native_io_enabled():
         return None
     with _lock:
-        if _lib is not None or _load_attempted:
+        if _load_attempted:
             return _lib
-        _load_attempted = True
-        for lib_path in _candidate_lib_paths():
-            try:
-                if not os.path.exists(lib_path) or os.path.getmtime(
-                    lib_path
-                ) < os.path.getmtime(_SRC):
-                    _build(lib_path)
-                _lib = _configure(ctypes.CDLL(lib_path))
-                logger.debug("Loaded native IO engine from %s", lib_path)
-                return _lib
-            except (OSError, subprocess.CalledProcessError) as e:
-                logger.debug("Native IO engine unavailable at %s: %s", lib_path, e)
-                continue
+    lib = _load_cached()
+    if lib is None:
+        # Build under its own lock so _lock stays responsive for
+        # load_native_nonblocking callers during the multi-second compile.
+        with _build_lock:
+            with _lock:
+                if _load_attempted:
+                    return _lib
+            lib = _load_cached()  # another builder may have just finished
+            if lib is None:
+                for lib_path in _candidate_lib_paths():
+                    try:
+                        _build(lib_path)
+                        lib = _configure(ctypes.CDLL(lib_path))
+                        logger.debug("Built native IO engine at %s", lib_path)
+                        break
+                    except (OSError, subprocess.CalledProcessError) as e:
+                        logger.debug(
+                            "Native IO engine build failed at %s: %s", lib_path, e
+                        )
+                        continue
+    if lib is None:
         logger.info("Native IO engine unavailable; using pure-Python file I/O")
-        return None
+    return _publish(lib)
 
 
 def load_native_nonblocking() -> Optional[ctypes.CDLL]:
@@ -124,30 +161,19 @@ def load_native_nonblocking() -> Optional[ctypes.CDLL]:
     dlopen, milliseconds). Otherwise the g++ build runs on a daemon thread
     and this returns ``None`` until it completes — callers fall back to
     buffered I/O in the meantime, keeping first-``take`` latency free of the
-    multi-second compile.
+    multi-second compile. ``_lock`` is never held across the build, so this
+    never stalls behind an in-flight compile either.
     """
-    global _lib, _load_attempted, _bg_build
+    global _bg_build
     from ..utils import knobs
 
     if not knobs.is_native_io_enabled():
         return None
     if _load_attempted:
         return _lib
-    for lib_path in _candidate_lib_paths():
-        try:
-            if os.path.exists(lib_path) and os.path.getmtime(
-                lib_path
-            ) >= os.path.getmtime(_SRC):
-                # dlopen THIS candidate directly: delegating to load_native()
-                # would re-walk the candidates in its own order and could hit
-                # a missing earlier path and compile synchronously.
-                with _lock:
-                    if not _load_attempted:
-                        _lib = _configure(ctypes.CDLL(lib_path))
-                        _load_attempted = True
-                    return _lib
-        except OSError:
-            continue
+    lib = _load_cached()
+    if lib is not None:
+        return _publish(lib)
     with _lock:
         if _load_attempted:
             return _lib

@@ -20,7 +20,7 @@ source training could still invalidate (mutable host arrays, objects) has
 been staged into private host buffers under the memory budget — the
 reference's capture semantics (``scheduler.py:178-214``). Requests flagged
 ``defer_staging`` (device arrays: immutable, and defensively forked against
-donation by ``io_preparer._defensive_device_copy``) skip that wait; the
+donation by ``io_preparer._defensive_device_copies``) skip that wait; the
 returned :class:`PendingIOWork` drains their device→host transfer plus all
 storage I/O in the background, still under the same budget. For
 device-dominated snapshots — the TPU norm — ``async_take``'s stall is thus
