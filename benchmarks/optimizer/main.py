@@ -43,6 +43,8 @@ def main() -> None:
 
     n = len(jax.devices())
     tp = args.tp or (2 if n % 2 == 0 else 1)
+    if n % tp != 0:
+        raise SystemExit(f"--tp {tp} must divide the device count ({n})")
     mesh = Mesh(np.array(jax.devices()).reshape(n // tp, tp), ("dp", "tp"))
     cfg = TransformerConfig(
         vocab_size=32000,

@@ -22,6 +22,7 @@ chunks for transfer/I-O pipelining.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -137,7 +138,9 @@ def _device_assignment_key(sharding) -> Any:
 
 def _batch_copy_fn(shardings: Tuple[Any, ...]):
     try:
-        return _BATCH_COPIES[shardings]
+        fn = _BATCH_COPIES[shardings]
+        _BATCH_COPIES.move_to_end(shardings)  # LRU: hits refresh recency
+        return fn
     except KeyError:
         import jax
         import jax.numpy as jnp
@@ -145,16 +148,19 @@ def _batch_copy_fn(shardings: Tuple[Any, ...]):
         fn = jax.jit(
             lambda xs: [jnp.copy(x) for x in xs], out_shardings=list(shardings)
         )
-        # jax.jit caches compiled executables internally; this dict only
-        # avoids rebuilding the Python wrapper. Bound it so long-running
-        # jobs with evolving state structures can't grow it without limit.
+        # The compiled executable lives on this wrapper object (a fresh
+        # wrapper can never reuse an evicted one's cache), so eviction means
+        # recompiling inside async_take's stall window. Keep the bound —
+        # evolving state structures must not grow this without limit — but
+        # evict least-RECENTLY-used so jobs alternating between a handful of
+        # state structures never churn.
         if len(_BATCH_COPIES) >= 16:
-            _BATCH_COPIES.pop(next(iter(_BATCH_COPIES)))
+            _BATCH_COPIES.popitem(last=False)
         _BATCH_COPIES[shardings] = fn
         return fn
 
 
-_BATCH_COPIES: Dict[Any, Any] = {}
+_BATCH_COPIES: "OrderedDict[Any, Any]" = OrderedDict()
 
 
 def prepare_write(

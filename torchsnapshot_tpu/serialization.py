@@ -110,8 +110,9 @@ def array_nbytes(shape, dtype_str: str) -> int:
 def array_as_bytes_view(arr: np.ndarray) -> memoryview:
     """Zero-copy little-endian raw-byte view of ``arr``.
 
-    Copies only when the array is non-contiguous or big-endian (never the case
-    for buffers fetched from an XLA device).
+    Copies only when the array is non-contiguous or big-endian. Device
+    fetches CAN be non-C-contiguous: ``np.asarray(jax.Array)`` reflects the
+    device layout, which for e.g. bf16 matrices on TPU may be F-order.
     """
     arr = np.ascontiguousarray(arr)
     if arr.dtype.byteorder == ">":
