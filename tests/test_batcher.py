@@ -1,5 +1,7 @@
 """Slab batching round-trips (reference model: ``tests/test_batcher.py``)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -69,3 +71,134 @@ def test_read_merge_adjacent() -> None:
     assert len(merged) == 2
     spans = sorted(r.byte_range for r in merged)
     assert spans == [(0, 8), (12, 16)]
+
+
+def _device_arrays(n=12, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+
+    return {
+        f"p{i}": jax.device_put(
+            jnp.arange(i * 24, (i + 1) * 24, dtype=jnp.dtype(dtype)).reshape(6, 4)
+        )
+        for i in range(n)
+    }
+
+
+@pytest.mark.parametrize(
+    "dtype", ["bfloat16", "float32", "int8", "bool", "float8_e4m3fn"]
+)
+def test_device_batched_take_restore(tmp_path, dtype, caplog) -> None:
+    """On-device slab packing (single D2H) must be byte-identical to the
+    host-side packing path for every byte-width dtype family."""
+    import jax.numpy as jnp
+
+    if dtype == "bool":
+        arrs = {
+            k: (v % 2 == 0) for k, v in _device_arrays(dtype="int32").items()
+        }
+    elif dtype == "float8_e4m3fn":
+        arrs = {
+            k: v.astype(jnp.float8_e4m3fn)
+            for k, v in _device_arrays(dtype="float32").items()
+        }
+    else:
+        arrs = _device_arrays(dtype=dtype)
+    expected = {k: np.ascontiguousarray(np.asarray(v)) for k, v in arrs.items()}
+    path = str(tmp_path / "dev")
+    from torchsnapshot_tpu import batcher as batcher_mod
+
+    batcher_mod._PACK_FNS.clear()
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.batcher"):
+        with knobs.override_batching_enabled(
+            True
+        ), knobs.override_slab_size_threshold_bytes(10**6):
+            snap = Snapshot.take(path, {"s": StateDict(**arrs)})
+    # The on-device packer must have engaged AND not fallen back to host
+    # packing (the jit wrapper is cached even when its call fails).
+    assert len(batcher_mod._PACK_FNS) == 1, "device packing did not engage"
+    assert not any(
+        "falling back" in r.message for r in caplog.records
+    ), "device packing fell back to host path"
+    out = StateDict(**{k: jnp.zeros_like(v) for k, v in arrs.items()})
+    Snapshot(path).restore({"s": out})
+    for k, want in expected.items():
+        got = np.ascontiguousarray(np.asarray(out[k]))
+        assert got.dtype == want.dtype, k
+        assert np.array_equal(
+            got.view(np.uint8), want.view(np.uint8)
+        ), f"{k} not bit-exact"
+    manifest = snap.get_manifest()
+    slabbed = {
+        e.location
+        for e in manifest.values()
+        if getattr(e, "location", "").startswith("batched/")
+    }
+    assert len(slabbed) == 1  # all members fit one slab
+
+
+def test_device_batched_matches_host_packed_bytes(tmp_path) -> None:
+    """The slab object written by the device packer must equal the one the
+    host packer writes for the same members."""
+    arrs = _device_arrays(dtype="float32")
+
+    def slab_bytes(root: str, device: bool) -> bytes:
+        with knobs.override_batching_enabled(
+            True
+        ), knobs.override_slab_size_threshold_bytes(10**6), knobs.override_device_batching(
+            device
+        ):
+            Snapshot.take(root, {"s": StateDict(**arrs)})
+        import glob as _glob
+
+        (slab,) = _glob.glob(os.path.join(root, "batched", "*"))
+        with open(slab, "rb") as f:
+            return f.read()
+
+    dev = slab_bytes(str(tmp_path / "dev"), True)
+    host = slab_bytes(str(tmp_path / "host"), False)
+    assert dev == host
+
+
+def test_device_batched_async_take(tmp_path, caplog) -> None:
+    """Deferred (async) slabs of device arrays pack on the background thread."""
+    from torchsnapshot_tpu import batcher as batcher_mod
+
+    arrs = _device_arrays(dtype="bfloat16")
+    expected = {k: np.ascontiguousarray(np.asarray(v)) for k, v in arrs.items()}
+    path = str(tmp_path / "async")
+    batcher_mod._PACK_FNS.clear()
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.batcher"):
+        with knobs.override_batching_enabled(
+            True
+        ), knobs.override_slab_size_threshold_bytes(10**6):
+            Snapshot.async_take(path, {"s": StateDict(**arrs)}).wait()
+    assert len(batcher_mod._PACK_FNS) == 1, "device packing did not engage"
+    assert not any("falling back" in r.message for r in caplog.records)
+    got = Snapshot(path).read_object("0/s/p3")
+    assert np.array_equal(
+        np.ascontiguousarray(np.asarray(got)).view(np.uint8),
+        expected["p3"].view(np.uint8),
+    )
+
+
+def test_device_batching_fallback_unsupported_dtype(tmp_path) -> None:
+    """A slab with a non-packable member (complex) takes the host path and
+    still round-trips."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import batcher as batcher_mod
+
+    arrs = _device_arrays(n=4, dtype="float32")
+    arrs["c"] = jnp.arange(8, dtype=jnp.complex64)
+    batcher_mod._PACK_FNS.clear()
+    path = str(tmp_path / "mix")
+    with knobs.override_batching_enabled(True), knobs.override_slab_size_threshold_bytes(
+        10**6
+    ):
+        Snapshot.take(path, {"s": StateDict(**arrs)})
+    assert len(batcher_mod._PACK_FNS) == 0  # device packer must NOT engage
+    out = StateDict(**{k: jnp.zeros_like(v) for k, v in arrs.items()})
+    Snapshot(path).restore({"s": out})
+    for k, v in arrs.items():
+        assert np.array_equal(np.asarray(out[k]), np.asarray(v)), k

@@ -19,6 +19,7 @@ Gated off by default behind ``knobs.is_batching_enabled()`` (reference
 from __future__ import annotations
 
 import asyncio
+import logging
 import uuid
 from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
@@ -36,8 +37,12 @@ from .manifest import (
     Entry,
     ShardedArrayEntry,
 )
+from .io_preparer import _device_assignment_key
 from .serialization import Serializer, array_nbytes
 from .utils import knobs
+from .utils.lru import BoundedLRU
+
+logger = logging.getLogger(__name__)
 
 
 def _collect_array_entries(entries: List[Entry]) -> Dict[str, ArrayEntry]:
@@ -87,6 +92,144 @@ class BatchedBufferStager(BufferStager):
             req.buffer_stager.start_d2h_hint()
 
 
+class DeviceBatchedBufferStager(BatchedBufferStager):
+    """Packs member device arrays into ONE on-device uint8 slab, fetched with
+    a single D2H transfer.
+
+    Analogue of the reference's ``GPUBatchedBufferStager``
+    (``batcher.py:102-157``), which packs CUDA source tensors into one device
+    buffer for a single copy and falls back on OOM. The TPU-native packing is
+    a jitted bitcast-to-bytes + concatenate: per-transfer overhead (latency,
+    descriptor setup) is paid once per slab instead of once per member —
+    exactly the regime slab batching targets (thousands of small params).
+    Any failure (unsupported dtype snuck through, compile error, device OOM,
+    a byte-length mismatch) falls back to the host-side per-member packing
+    inherited from :class:`BatchedBufferStager`.
+    """
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        import numpy as np
+
+        from .io_preparers.array import to_host
+
+        try:
+            packed = _pack_to_device_bytes(
+                tuple(req.buffer_stager.arr for req, _, _ in self.members)
+            )
+            # to_host wraps the async-hint-then-resolve pattern; a device-side
+            # failure (e.g. async HBM OOM from the pack's allocation)
+            # surfaces at the resolve and falls back too.
+            host = await to_host(packed, executor)()
+            if host.nbytes != self.total:
+                raise RuntimeError(
+                    f"Device-packed slab is {host.nbytes} bytes, "
+                    f"planned {self.total}"
+                )
+        except Exception:
+            logger.warning(
+                "On-device slab packing failed; falling back to host-side "
+                "packing for %d members",
+                len(self.members),
+                exc_info=True,
+            )
+            return await super().stage_buffer(executor)
+        return np.ascontiguousarray(host)
+
+    def start_d2h_hint(self) -> None:
+        # Deliberately a no-op: packing here would run a jit trace+compile on
+        # async_take's capture path (the stall this design exists to avoid)
+        # and pin every packed slab in HBM until the background drain. Slabs
+        # are < the slab threshold by construction — losing their eager-D2H
+        # prefetch is cheap; the background staging packs and fetches them.
+        pass
+
+
+# Dtypes an on-device packed slab can carry: byte-width dtypes whose jitted
+# bitcast-to-uint8 byte stream equals the host array's raw little-endian
+# bytes. Sub-byte dtypes (int4/uint4/float4) are excluded — numpy stores
+# them unpacked one-per-byte, and an 8→4-bit bitcast would mis-size the
+# slab. bool packs via astype (same 0/1 byte representation). Complex
+# bitcasts are unsupported by XLA.
+_DEVICE_PACKABLE_DTYPES = frozenset(
+    {
+        "bool",
+        "int8",
+        "int16",
+        "int32",
+        "int64",
+        "uint8",
+        "uint16",
+        "uint32",
+        "uint64",
+        "float16",
+        "float32",
+        "float64",
+        "bfloat16",
+        "float8_e4m3fn",
+        "float8_e5m2",
+        "float8_e4m3b11fnuz",
+        "float8_e4m3fnuz",
+        "float8_e5m2fnuz",
+    }
+)
+
+
+def _device_batchable(req: WriteReq) -> bool:
+    """True when a member can join an on-device packed slab."""
+    from .io_preparers.array import ArrayBufferStager, _is_jax_array
+
+    stager = req.buffer_stager
+    if not isinstance(stager, ArrayBufferStager) or not _is_jax_array(stager.arr):
+        return False
+    arr = stager.arr
+    # Fully-addressable only: packing is an independent local computation, so
+    # it stays legal from the async-commit background thread (no SPMD
+    # program-order requirement across processes).
+    if not getattr(arr, "is_fully_addressable", False):
+        return False
+    import numpy as np
+
+    return np.dtype(arr.dtype).name in _DEVICE_PACKABLE_DTYPES
+
+
+def _pack_to_device_bytes(arrs):
+    """Jitted concat of each array's raw little-endian bytes (C order)."""
+    key = tuple(
+        (str(a.dtype), a.shape, _device_assignment_key(a.sharding)) for a in arrs
+    )
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def pack(xs):
+            parts = []
+            for x in xs:
+                if x.dtype == jnp.bool_:
+                    b = x.astype(jnp.uint8)
+                else:
+                    # bitcast to uint8 appends a trailing axis of itemsize
+                    # (none for 1-byte dtypes); C-order flatten of
+                    # (element, byte-within-element) is the array's raw
+                    # little-endian byte stream.
+                    b = lax.bitcast_convert_type(x, jnp.uint8)
+                parts.append(b.reshape(-1))
+            return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+        return jax.jit(pack)
+
+    return _PACK_FNS.get_or_build(key, build)(arrs)
+
+
+# One key per slab (not per state structure): a checkpoint with N small-param
+# slabs touches N keys per take in a fixed order, so the capacity must
+# comfortably exceed any realistic slab count — at the 128 MB threshold, 256
+# slabs ≈ 32 GB of small params. A sequential scan over more keys than
+# capacity is the LRU worst case (0% hits, full recompile every take).
+_PACK_FNS = BoundedLRU(capacity=256)
+
+
 def batch_write_requests(
     entries: List[Entry], write_reqs: List[WriteReq]
 ) -> Tuple[List[Entry], List[WriteReq]]:
@@ -130,10 +273,22 @@ def batch_write_requests(
         for (req, begin, end), entry in zip(slab, slab_entries):
             entry.location = slab_path
             entry.byte_range = [begin, end]
+        stager: BufferStager
+        if (
+            knobs.is_device_batching_enabled()
+            and all(_device_batchable(req) for req, _, _ in slab)
+            and len(
+                {_device_assignment_key(req.buffer_stager.arr.sharding) for req, _, _ in slab}
+            )
+            == 1
+        ):
+            stager = DeviceBatchedBufferStager(slab)
+        else:
+            stager = BatchedBufferStager(slab)
         batched_reqs.append(
             WriteReq(
                 path=slab_path,
-                buffer_stager=BatchedBufferStager(slab),
+                buffer_stager=stager,
                 # Deferring past async_take's return is only safe when every
                 # member is (immutable device data); one mutable host member
                 # forces the whole slab to stage at the capture point.

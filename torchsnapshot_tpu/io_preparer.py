@@ -22,7 +22,6 @@ chunks for transfer/I-O pipelining.
 
 from __future__ import annotations
 
-from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -41,6 +40,7 @@ from .io_preparers.array import ArrayIOPreparer
 from .io_preparers.chunked_array import ChunkedArrayIOPreparer, should_chunk
 from .io_preparers.object import ObjectIOPreparer
 from .io_preparers.sharded_array import ShardedArrayIOPreparer
+from .utils.lru import BoundedLRU
 
 
 def get_storage_path(logical_path: str, rank: int, replicated: bool) -> str:
@@ -137,30 +137,18 @@ def _device_assignment_key(sharding) -> Any:
 
 
 def _batch_copy_fn(shardings: Tuple[Any, ...]):
-    try:
-        fn = _BATCH_COPIES[shardings]
-        _BATCH_COPIES.move_to_end(shardings)  # LRU: hits refresh recency
-        return fn
-    except KeyError:
+    def build():
         import jax
         import jax.numpy as jnp
 
-        fn = jax.jit(
+        return jax.jit(
             lambda xs: [jnp.copy(x) for x in xs], out_shardings=list(shardings)
         )
-        # The compiled executable lives on this wrapper object (a fresh
-        # wrapper can never reuse an evicted one's cache), so eviction means
-        # recompiling inside async_take's stall window. Keep the bound —
-        # evolving state structures must not grow this without limit — but
-        # evict least-RECENTLY-used so jobs alternating between a handful of
-        # state structures never churn.
-        if len(_BATCH_COPIES) >= 16:
-            _BATCH_COPIES.popitem(last=False)
-        _BATCH_COPIES[shardings] = fn
-        return fn
+
+    return _BATCH_COPIES.get_or_build(shardings, build)
 
 
-_BATCH_COPIES: "OrderedDict[Any, Any]" = OrderedDict()
+_BATCH_COPIES = BoundedLRU()
 
 
 def prepare_write(

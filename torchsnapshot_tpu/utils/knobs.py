@@ -54,6 +54,20 @@ def is_batching_enabled() -> bool:
 
 _ENV_ASYNC_DEVICE_COPY = "TORCHSNAPSHOT_TPU_ASYNC_DEVICE_COPY"
 _ENV_ASYNC_EAGER_D2H = "TORCHSNAPSHOT_TPU_ASYNC_EAGER_D2H"
+_ENV_DEVICE_BATCHING = "TORCHSNAPSHOT_TPU_DEVICE_BATCHING"
+
+
+def is_device_batching_enabled() -> bool:
+    """Pack slab members on-device and fetch with one D2H transfer.
+
+    Only applies when slab batching itself is on and every member of a slab
+    is a fully-addressable device array of a byte-width dtype.
+    """
+    return os.environ.get(_ENV_DEVICE_BATCHING, "1") not in ("0", "false", "False")
+
+
+def override_device_batching(enabled: bool):
+    return _override_env(_ENV_DEVICE_BATCHING, "1" if enabled else "0")
 
 
 def is_async_device_copy_enabled() -> bool:

@@ -1,0 +1,43 @@
+"""Bounded LRU for jitted-function wrappers.
+
+jax.jit's compiled executables live on the returned wrapper object — a fresh
+wrapper can never reuse an evicted one's cache — so eviction means
+recompiling (inside ``async_take``'s stall window, for the callers here).
+The bound keeps jobs with unboundedly evolving state structures from growing
+the cache forever; least-recently-used eviction keeps jobs that alternate
+among a handful of structures from ever churning.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+_DEFAULT_CAPACITY = 16
+
+
+class BoundedLRU:
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY) -> None:
+        self._capacity = capacity
+        self._data: "OrderedDict[object, object]" = OrderedDict()
+
+    def get_or_build(self, key: object, build: Callable[[], object]) -> object:
+        try:
+            value = self._data[key]
+            self._data.move_to_end(key)  # hits refresh recency
+            return value
+        except KeyError:
+            value = build()
+            if len(self._data) >= self._capacity:
+                self._data.popitem(last=False)
+            self._data[key] = value
+            return value
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()
