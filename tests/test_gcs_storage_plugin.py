@@ -139,6 +139,47 @@ def test_transient_errors_retried(fake_gcs) -> None:
     assert fail_reads["blob"] == 0
 
 
+def test_collective_progress_outlasts_fixed_attempt_caps(fake_gcs) -> None:
+    """Transient errors retry as long as the plugin's collective-progress
+    window is open — here 9 consecutive failures (more than any fixed
+    attempt cap) still recover."""
+    blobs, fail_reads = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+    blobs["blob"] = b"payload"
+    fail_reads["blob"] = 9
+
+    async def go():
+        rio = ReadIO(path="blob")
+        await plugin.read(rio)
+        await plugin.close()
+        return rio.buf.getvalue()
+
+    assert _run(go()) == b"payload"
+
+
+def test_collective_progress_deadline_expires(fake_gcs) -> None:
+    """Once no op on the plugin has made progress for window_s, a transient
+    error propagates instead of retrying forever."""
+    blobs, fail_reads = fake_gcs
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+    plugin._progress.window_s = 0.0  # expire immediately
+    plugin._progress._last -= 1.0
+    blobs["blob"] = b"payload"
+    fail_reads["blob"] = 1
+
+    async def go():
+        rio = ReadIO(path="blob")
+        await plugin.read(rio)
+
+    with pytest.raises(ConnectionError):
+        _run(go())
+    _run(plugin.close())
+
+
 def test_nontransient_error_propagates(fake_gcs) -> None:
     from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
 

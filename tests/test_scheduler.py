@@ -153,3 +153,14 @@ def test_write_failure_propagates() -> None:
 def test_memory_budget_override_knob() -> None:
     with knobs.override_memory_budget_bytes(12345):
         assert get_process_memory_budget_bytes(None) == 12345
+
+
+def test_progress_reporter_logs_occupancy(caplog) -> None:
+    from torchsnapshot_tpu.scheduler import _Budget, _ProgressReporter
+
+    rep = _ProgressReporter(rank=0, kind="write", interval_s=0.0)
+    with caplog.at_level("INFO", logger="torchsnapshot_tpu.scheduler"):
+        rep.maybe_report({"pending": 3, "io": 2}, 12_000_000, _Budget(10**9))
+    (rec,) = [r for r in caplog.records if "pipeline" in r.message]
+    msg = rec.getMessage()
+    assert "pending=3" in msg and "io=2" in msg and "0.01 GB done" in msg

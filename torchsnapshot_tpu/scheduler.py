@@ -82,6 +82,46 @@ class _Budget:
         self.available += n
 
 
+class _ProgressReporter:
+    """Periodic per-rank pipeline-occupancy logging (reference
+    ``scheduler.py:96-175``): how many requests sit in each stage, bytes
+    moved, budget headroom, and RSS delta since the pipeline began. Logged
+    at most once per ``interval_s``, from the event-loop side of the
+    pipeline (so a stall in staging/I-O shows its last known occupancy)."""
+
+    def __init__(self, rank: int, kind: str, interval_s: float = 10.0) -> None:
+        self.rank = rank
+        self.kind = kind
+        self.interval_s = interval_s
+        self._last_ts = time.monotonic()
+        try:
+            self._rss0 = psutil.Process(os.getpid()).memory_info().rss
+        except Exception:  # pragma: no cover - psutil hiccup
+            self._rss0 = 0
+
+    def maybe_report(self, stages: Dict[str, int], bytes_done: int, budget: _Budget) -> None:
+        now = time.monotonic()
+        if now - self._last_ts < self.interval_s:
+            return
+        self._last_ts = now
+        try:
+            rss_delta = psutil.Process(os.getpid()).memory_info().rss - self._rss0
+        except Exception:  # pragma: no cover
+            rss_delta = 0
+        occupancy = " ".join(f"{k}={v}" for k, v in stages.items())
+        logger.info(
+            "Rank %d %s pipeline: %s | %.2f GB done | budget %.2f/%.2f GB | "
+            "RSS delta %+.2f GB",
+            self.rank,
+            self.kind,
+            occupancy,
+            bytes_done / 1e9,
+            budget.available / 1e9,
+            budget.total / 1e9,
+            rss_delta / 1e9,
+        )
+
+
 class _WritePipeline:
     """The write-side state machine; resumable so deferred staging
     (``WriteReq.defer_staging``) can finish on the async-commit background
@@ -115,6 +155,20 @@ class _WritePipeline:
         self.bytes_staged = 0
         self.staged_ts: Optional[float] = None
         self.executor: Optional[ThreadPoolExecutor] = None
+        self.reporter = _ProgressReporter(rank, "write")
+
+    def _report(self) -> None:
+        self.reporter.maybe_report(
+            {
+                "pending": len(self.pending),
+                "deferred": len(self.deferred),
+                "staging": len(self.staging_tasks),
+                "ready_for_io": len(self.ready_for_io),
+                "io": len(self.io_tasks),
+            },
+            self.bytes_staged,
+            self.budget,
+        )
 
     def _dispatch_staging(self) -> None:
         if self.executor is None:
@@ -167,10 +221,14 @@ class _WritePipeline:
                 done, _ = await asyncio.wait(
                     set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
+                    # Bounded so the reporter fires during a stall (when no
+                    # task completes, wait returns with done == set()).
+                    timeout=self.reporter.interval_s,
                 )
                 self._reap(done)
                 self._dispatch_io()
                 self._dispatch_staging()
+                self._report()
         except BaseException:
             self._shutdown_executor()
             raise
@@ -190,10 +248,14 @@ class _WritePipeline:
                 done, _ = await asyncio.wait(
                     set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
+                    # Bounded so the reporter fires during a stall (when no
+                    # task completes, wait returns with done == set()).
+                    timeout=self.reporter.interval_s,
                 )
                 self._reap(done)
                 self._dispatch_io()
                 self._dispatch_staging()
+                self._report()
                 if not self.staging_tasks and not self.pending:
                     self._mark_staged()
         finally:
@@ -279,6 +341,7 @@ async def execute_read_reqs(
     consume_tasks: Dict[asyncio.Task, int] = {}
     bytes_read = 0
     executor = ThreadPoolExecutor(max_workers=_MAX_CONSUMING_THREADS)
+    reporter = _ProgressReporter(rank, "read")
 
     async def read_one(req: ReadReq) -> object:
         read_io = ReadIO(path=req.path, byte_range=req.byte_range)
@@ -302,6 +365,7 @@ async def execute_read_reqs(
             done, _ = await asyncio.wait(
                 set(io_tasks.keys()) | set(consume_tasks.keys()),
                 return_when=asyncio.FIRST_COMPLETED,
+                timeout=reporter.interval_s,
             )
             for task in done:
                 if task in io_tasks:
@@ -318,6 +382,15 @@ async def execute_read_reqs(
                     task.result()
                     budget.credit(cost)
             dispatch_reads()
+            reporter.maybe_report(
+                {
+                    "pending": len(pending),
+                    "io": len(io_tasks),
+                    "consume": len(consume_tasks),
+                },
+                bytes_read,
+                budget,
+            )
     finally:
         executor.shutdown(wait=False)
 

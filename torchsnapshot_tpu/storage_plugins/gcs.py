@@ -20,14 +20,38 @@ import asyncio
 import random
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..memoryview_stream import MemoryviewStream
 
 _IO_THREADS = 8
-_MAX_ATTEMPTS = 5
 _BASE_BACKOFF_S = 0.5
+_MAX_BACKOFF_S = 8.0
+_PROGRESS_WINDOW_S = 120.0
+
+
+class _CollectiveProgress:
+    """Shared retry deadline across all concurrent ops on one plugin
+    (reference ``gcs.py:214-270``).
+
+    Under congestion every operation slows down together; a fixed per-op
+    attempt cap aborts requests that are merely queued behind slow peers.
+    Instead, the deadline is refreshed whenever any operation *starts* or
+    *succeeds*, and an op only gives up on a transient error once the plugin
+    as a whole has neither started nor completed anything for ``window_s`` —
+    so a total outage expires 120 s after the last activity, while an idle
+    gap between checkpoints can never pre-expire the first write's retries.
+    """
+
+    def __init__(self, window_s: float = _PROGRESS_WINDOW_S) -> None:
+        self.window_s = window_s
+        self._last = time.monotonic()
+
+    def note_progress(self) -> None:
+        self._last = time.monotonic()
+
+    def out_of_time(self) -> bool:
+        return time.monotonic() - self._last > self.window_s
 
 
 class GCSStoragePlugin(StoragePlugin):
@@ -43,24 +67,29 @@ class GCSStoragePlugin(StoragePlugin):
         self._client = gcs.Client()
         self._bucket = self._client.bucket(bucket_name)
         self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
+        self._progress = _CollectiveProgress()
 
     def _blob_path(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
     async def _retrying(self, fn) -> object:
         loop = asyncio.get_event_loop()
-        last: Optional[Exception] = None
-        for attempt in range(_MAX_ATTEMPTS):
+        attempt = 0
+        self._progress.note_progress()  # op start counts as activity
+        while True:
             try:
-                return await loop.run_in_executor(self._executor, fn)
+                result = await loop.run_in_executor(self._executor, fn)
             except Exception as e:  # noqa: BLE001 - classified below
-                if not _is_transient(e) or attempt == _MAX_ATTEMPTS - 1:
+                if not _is_transient(e) or self._progress.out_of_time():
                     raise
-                last = e
+                attempt += 1
                 await asyncio.sleep(
-                    _BASE_BACKOFF_S * (2**attempt) * (0.5 + random.random())
+                    min(_MAX_BACKOFF_S, _BASE_BACKOFF_S * (2**attempt))
+                    * (0.5 + random.random())
                 )
-        raise last  # pragma: no cover
+            else:
+                self._progress.note_progress()
+                return result
 
     async def write(self, write_io: WriteIO) -> None:
         blob = self._bucket.blob(self._blob_path(write_io.path))
