@@ -202,3 +202,33 @@ def test_device_batching_fallback_unsupported_dtype(tmp_path) -> None:
     Snapshot(path).restore({"s": out})
     for k, v in arrs.items():
         assert np.array_equal(np.asarray(out[k]), np.asarray(v)), k
+
+
+def test_device_pack_failure_memoized(tmp_path, caplog, monkeypatch) -> None:
+    """A failing pack signature warns once, then skips the device path on
+    subsequent takes instead of re-failing (and re-warning) every time."""
+    from torchsnapshot_tpu import batcher as batcher_mod
+
+    def boom(key, arrs):
+        raise RuntimeError("simulated pack failure")
+
+    monkeypatch.setattr(batcher_mod, "_pack_to_device_bytes", boom)
+    monkeypatch.setattr(batcher_mod, "_PACK_FAILED", {})  # auto-restored
+    arrs = _device_arrays(n=4, dtype="float32")
+    expected = {k: np.asarray(v) for k, v in arrs.items()}
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.batcher"):
+        with knobs.override_batching_enabled(
+            True
+        ), knobs.override_slab_size_threshold_bytes(10**6):
+            Snapshot.take(str(tmp_path / "a"), {"s": StateDict(**arrs)})
+            first_warnings = sum(
+                "falling back" in r.message for r in caplog.records
+            )
+            Snapshot.take(str(tmp_path / "b"), {"s": StateDict(**arrs)})
+    total_warnings = sum("falling back" in r.message for r in caplog.records)
+    assert first_warnings == 1
+    assert total_warnings == 1  # second take skipped silently
+    out = StateDict()
+    Snapshot(str(tmp_path / "b")).restore({"s": out})
+    for k, want in expected.items():
+        assert np.array_equal(np.asarray(out[k]), want), k

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import uuid
 from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
@@ -112,10 +113,20 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
 
         from .io_preparers.array import to_host
 
+        arrs = tuple(req.buffer_stager.arr for req, _, _ in self.members)
+        key = _pack_key(arrs)
+        failed_at = _PACK_FAILED.get(key)
+        if failed_at is not None:
+            if time.monotonic() - failed_at < _PACK_RETRY_COOLDOWN_S:
+                # This signature failed recently; don't pay a failed
+                # trace/compile plus a full-traceback warning on every take.
+                return await super().stage_buffer(executor)
+            # Cooldown elapsed: transient causes (a momentary HBM pressure
+            # spike at the to_host resolve) deserve another chance; a
+            # deterministic compile failure will just re-memoize.
+            del _PACK_FAILED[key]
         try:
-            packed = _pack_to_device_bytes(
-                tuple(req.buffer_stager.arr for req, _, _ in self.members)
-            )
+            packed = _pack_to_device_bytes(key, arrs)
             # to_host wraps the async-hint-then-resolve pattern; a device-side
             # failure (e.g. async HBM OOM from the pack's allocation)
             # surfaces at the resolve and falls back too.
@@ -126,10 +137,14 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
                     f"planned {self.total}"
                 )
         except Exception:
+            if len(_PACK_FAILED) < _PACK_FAILED_CAP:
+                _PACK_FAILED[key] = time.monotonic()
             logger.warning(
                 "On-device slab packing failed; falling back to host-side "
-                "packing for %d members",
+                "packing for %d members (device path for this slab "
+                "signature paused for %.0f s)",
                 len(self.members),
+                _PACK_RETRY_COOLDOWN_S,
                 exc_info=True,
             )
             return await super().stage_buffer(executor)
@@ -192,11 +207,14 @@ def _device_batchable(req: WriteReq) -> bool:
     return np.dtype(arr.dtype).name in _DEVICE_PACKABLE_DTYPES
 
 
-def _pack_to_device_bytes(arrs):
-    """Jitted concat of each array's raw little-endian bytes (C order)."""
-    key = tuple(
+def _pack_key(arrs) -> tuple:
+    return tuple(
         (str(a.dtype), a.shape, _device_assignment_key(a.sharding)) for a in arrs
     )
+
+
+def _pack_to_device_bytes(key, arrs):
+    """Jitted concat of each array's raw little-endian bytes (C order)."""
 
     def build():
         import jax
@@ -228,6 +246,15 @@ def _pack_to_device_bytes(arrs):
 # slabs ≈ 32 GB of small params. A sequential scan over more keys than
 # capacity is the LRU worst case (0% hits, full recompile every take).
 _PACK_FNS = BoundedLRU(capacity=256)
+
+# key -> monotonic time of last device-path failure. Failed signatures skip
+# straight to host packing until the cooldown elapses (transient causes like
+# momentary HBM pressure recover; deterministic compile failures re-memoize
+# after one retry per cooldown). Capped so pathological signature churn
+# can't grow it forever (beyond the cap, new failures just retry+warn).
+_PACK_FAILED: dict = {}
+_PACK_FAILED_CAP = 1024
+_PACK_RETRY_COOLDOWN_S = 600.0
 
 
 def batch_write_requests(

@@ -17,12 +17,15 @@ at import time.
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..memoryview_stream import MemoryviewStream
+
+logger = logging.getLogger(__name__)
 
 _IO_THREADS = 8
 _BASE_BACKOFF_S = 0.5
@@ -83,10 +86,17 @@ class GCSStoragePlugin(StoragePlugin):
                 if not _is_transient(e) or self._progress.out_of_time():
                     raise
                 attempt += 1
-                await asyncio.sleep(
-                    min(_MAX_BACKOFF_S, _BASE_BACKOFF_S * (2**attempt))
-                    * (0.5 + random.random())
+                backoff = min(_MAX_BACKOFF_S, _BASE_BACKOFF_S * (2**attempt)) * (
+                    0.5 + random.random()
                 )
+                logger.warning(
+                    "Transient GCS error (attempt %d, retrying in %.1fs while "
+                    "the plugin makes collective progress): %s",
+                    attempt,
+                    backoff,
+                    e,
+                )
+                await asyncio.sleep(backoff)
             else:
                 self._progress.note_progress()
                 return result
