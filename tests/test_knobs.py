@@ -67,3 +67,26 @@ def test_exception_inside_override_still_restores() -> None:
     except ValueError:
         pass
     assert knobs.get_slab_size_threshold_bytes() == 128 * 1024 * 1024
+
+
+def test_scheduler_concurrency_knobs() -> None:
+    from torchsnapshot_tpu.utils import knobs
+
+    assert knobs.get_staging_threads() == 4
+    assert knobs.get_max_concurrent_io() == 16
+    assert knobs.get_consuming_threads() == 4
+    with knobs.override_staging_threads(8), knobs.override_max_concurrent_io(
+        2
+    ), knobs.override_consuming_threads(1):
+        assert knobs.get_staging_threads() == 8
+        assert knobs.get_max_concurrent_io() == 2
+        assert knobs.get_consuming_threads() == 1
+    assert knobs.get_staging_threads() == 4
+
+
+def test_scheduler_concurrency_knobs_floor_at_one() -> None:
+    from torchsnapshot_tpu.utils import knobs
+
+    with knobs.override_staging_threads(0), knobs.override_max_concurrent_io(-3):
+        assert knobs.get_staging_threads() == 1
+        assert knobs.get_max_concurrent_io() == 1

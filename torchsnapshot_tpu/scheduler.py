@@ -7,7 +7,9 @@ Write pipeline stages::
 
     ready_for_staging ──(budget admits)──> staging ──> ready_for_io ──> io ──> done
                          D2H + serialize                 storage.write
-                         (thread pool)                   (async, <=16 in flight)
+                         (thread pool,                   (async, in-flight cap:
+                          TORCHSNAPSHOT_TPU_              TORCHSNAPSHOT_TPU_
+                          STAGING_THREADS)                MAX_CONCURRENT_IO)
 
 The memory budget is debited by each request's estimated staging cost when it
 is admitted, corrected to the actual buffer size when staging completes, and
@@ -51,9 +53,6 @@ logger = logging.getLogger(__name__)
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
-_MAX_CONCURRENT_IO = 16
-_MAX_STAGING_THREADS = 4
-_MAX_CONSUMING_THREADS = 4
 
 
 def get_process_memory_budget_bytes(coordinator=None) -> int:
@@ -172,7 +171,9 @@ class _WritePipeline:
 
     def _dispatch_staging(self) -> None:
         if self.executor is None:
-            self.executor = ThreadPoolExecutor(max_workers=_MAX_STAGING_THREADS)
+            self.executor = ThreadPoolExecutor(
+                max_workers=knobs.get_staging_threads()
+            )
         while self.pending:
             cost = self.pending[0].buffer_stager.get_staging_cost_bytes()
             over_budget = cost > self.budget.available
@@ -185,7 +186,7 @@ class _WritePipeline:
             self.staging_tasks[task] = (req, cost)
 
     def _dispatch_io(self) -> None:
-        while self.ready_for_io and len(self.io_tasks) < _MAX_CONCURRENT_IO:
+        while self.ready_for_io and len(self.io_tasks) < knobs.get_max_concurrent_io():
             path, buf = self.ready_for_io.popleft()
             nbytes = memoryview(buf).nbytes
             task = asyncio.ensure_future(
@@ -340,7 +341,7 @@ async def execute_read_reqs(
     io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int]] = {}
     consume_tasks: Dict[asyncio.Task, int] = {}
     bytes_read = 0
-    executor = ThreadPoolExecutor(max_workers=_MAX_CONSUMING_THREADS)
+    executor = ThreadPoolExecutor(max_workers=knobs.get_consuming_threads())
     reporter = _ProgressReporter(rank, "read")
 
     async def read_one(req: ReadReq) -> object:
@@ -349,7 +350,7 @@ async def execute_read_reqs(
         return read_io.buf.getbuffer()
 
     def dispatch_reads() -> None:
-        while pending and len(io_tasks) < _MAX_CONCURRENT_IO:
+        while pending and len(io_tasks) < knobs.get_max_concurrent_io():
             cost = pending[0].buffer_consumer.get_consuming_cost_bytes()
             over_budget = cost > budget.available
             pipeline_empty = not io_tasks and not consume_tasks

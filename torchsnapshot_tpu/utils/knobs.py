@@ -146,6 +146,38 @@ def get_memory_budget_override_bytes() -> Optional[int]:
     return int(val) if val is not None else None
 
 
+_ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
+_ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
+_ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
+
+
+def get_staging_threads() -> int:
+    """Thread-pool width for D2H + serialize staging (reference fixed 4)."""
+    return max(1, _get_int(_ENV_STAGING_THREADS, 4))
+
+
+def get_max_concurrent_io() -> int:
+    """Storage ops in flight per pipeline (reference fixed 16)."""
+    return max(1, _get_int(_ENV_MAX_CONCURRENT_IO, 16))
+
+
+def get_consuming_threads() -> int:
+    """Thread-pool width for deserialize + scatter on restore."""
+    return max(1, _get_int(_ENV_CONSUMING_THREADS, 4))
+
+
+def override_staging_threads(value: int):
+    return _override_env(_ENV_STAGING_THREADS, str(value))
+
+
+def override_max_concurrent_io(value: int):
+    return _override_env(_ENV_MAX_CONCURRENT_IO, str(value))
+
+
+def override_consuming_threads(value: int):
+    return _override_env(_ENV_CONSUMING_THREADS, str(value))
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: str) -> Generator[None, None, None]:
     prev = os.environ.get(name)
