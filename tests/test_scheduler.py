@@ -74,7 +74,9 @@ def test_write_budget_bounds_staged_bytes() -> None:
     reqs = [WriteReq(f"p{i}", TrackingStager(100)) for i in range(50)]
     storage = ReleasingStorage()
     _run_write(reqs, storage, budget=300)
-    assert len(storage.objects) == 50
+    data_objects = [k for k in storage.objects if not k.startswith(".checksums")]
+    assert len(data_objects) == 50
+    assert ".checksums.0" in storage.objects  # integrity sidecar
     # Peak staged bytes stays within budget + one over-admitted request.
     assert TrackingStager.peak <= 300 + 100
 
@@ -84,7 +86,8 @@ def test_budget_deadlock_avoided_single_huge_req() -> None:
     reqs = [WriteReq("huge", TrackingStager(10_000))]
     storage = ReleasingStorage()
     _run_write(reqs, storage, budget=10)
-    assert len(storage.objects) == 1  # over-budget req still admitted
+    data_objects = [k for k in storage.objects if not k.startswith(".checksums")]
+    assert len(data_objects) == 1  # over-budget req still admitted
 
 
 def test_pending_io_work_defers_io() -> None:
@@ -105,7 +108,8 @@ def test_pending_io_work_defers_io() -> None:
         return staged_but_unwritten
 
     assert _run(staged_then_drain())
-    assert len(storage.objects) == 20
+    data_objects = [k for k in storage.objects if not k.startswith(".checksums")]
+    assert len(data_objects) == 20
 
 
 class CountingConsumer(BufferConsumer):

@@ -36,10 +36,12 @@ thread pool.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import socket
 import time
+import zlib
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Deque, Dict, List, Optional, Set, Tuple
@@ -50,6 +52,8 @@ from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .utils import knobs
 
 logger = logging.getLogger(__name__)
+
+CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
@@ -155,6 +159,8 @@ class _WritePipeline:
         self.staged_ts: Optional[float] = None
         self.executor: Optional[ThreadPoolExecutor] = None
         self.reporter = _ProgressReporter(rank, "write")
+        self.checksums: Dict[str, int] = {}
+        self._crc_executor: Optional[ThreadPoolExecutor] = None
 
     def _report(self) -> None:
         self.reporter.maybe_report(
@@ -189,10 +195,23 @@ class _WritePipeline:
         while self.ready_for_io and len(self.io_tasks) < knobs.get_max_concurrent_io():
             path, buf = self.ready_for_io.popleft()
             nbytes = memoryview(buf).nbytes
-            task = asyncio.ensure_future(
-                self.storage.write(WriteIO(path=path, buf=buf))
-            )
+            task = asyncio.ensure_future(self._write_one(path, buf))
             self.io_tasks[task] = nbytes
+
+    async def _write_one(self, path: str, buf) -> None:
+        if knobs.is_checksums_enabled():
+            # CRC32 releases the GIL; it runs on a small DEDICATED pool so a
+            # staging pool saturated with multi-second D2H jobs can't
+            # head-of-line block storage writes behind queued staging work.
+            # Recorded per *storage object* so ``Snapshot.verify()`` can
+            # audit files without the manifest.
+            loop = asyncio.get_event_loop()
+            if self._crc_executor is None:
+                self._crc_executor = ThreadPoolExecutor(max_workers=2)
+            self.checksums[path] = await loop.run_in_executor(
+                self._crc_executor, zlib.crc32, memoryview(buf)
+            )
+        await self.storage.write(WriteIO(path=path, buf=buf))
 
     def _reap(self, done) -> None:
         for task in done:
@@ -259,6 +278,15 @@ class _WritePipeline:
                 self._report()
                 if not self.staging_tasks and not self.pending:
                     self._mark_staged()
+            if self.checksums:
+                # Pre-commit (the caller barriers before rank 0 writes the
+                # metadata file), so a committed snapshot always carries its
+                # checksum sidecars.
+                payload = json.dumps(self.checksums, sort_keys=True).encode()
+                self.checksums = {}
+                await self.storage.write(
+                    WriteIO(path=f"{CHECKSUM_FILE_PREFIX}{self.rank}", buf=payload)
+                )
         finally:
             self._shutdown_executor()
         elapsed = time.monotonic() - self.begin_ts
@@ -285,6 +313,9 @@ class _WritePipeline:
         if self.executor is not None:
             self.executor.shutdown(wait=False)
             self.executor = None
+        if self._crc_executor is not None:
+            self._crc_executor.shutdown(wait=False)
+            self._crc_executor = None
 
 
 class PendingIOWork:

@@ -146,6 +146,21 @@ def get_memory_budget_override_bytes() -> Optional[int]:
     return int(val) if val is not None else None
 
 
+_ENV_CHECKSUMS = "TORCHSNAPSHOT_TPU_CHECKSUMS"
+
+
+def is_checksums_enabled() -> bool:
+    """Record a CRC32 per storage object at write time (verified on demand
+    by ``Snapshot.verify()``). CRC32 runs at GB/s with the GIL released and
+    overlaps storage I/O in the staging pool, so the cost is usually hidden
+    behind the write path's bottleneck."""
+    return os.environ.get(_ENV_CHECKSUMS, "1") not in ("0", "false", "False")
+
+
+def override_checksums(enabled: bool):
+    return _override_env(_ENV_CHECKSUMS, "1" if enabled else "0")
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
