@@ -1,0 +1,55 @@
+"""CLI: ls / cat / verify (no reference analogue — operator tooling)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.__main__ import main
+
+
+@pytest.fixture
+def snap_path(tmp_path):
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(
+        path,
+        {
+            "m": StateDict(
+                w=np.arange(12, dtype=np.float32).reshape(3, 4), step=7
+            )
+        },
+    )
+    return path
+
+
+def test_cli_ls(snap_path, capsys) -> None:
+    assert main(["ls", snap_path]) == 0
+    out = capsys.readouterr().out
+    assert "0/m/w" in out and "float32[3, 4]" in out
+    assert "0/m/step" in out
+
+
+def test_cli_cat(snap_path, capsys) -> None:
+    assert main(["cat", snap_path, "0/m/step"]) == 0
+    assert capsys.readouterr().out.strip() == "7"
+    assert main(["cat", snap_path, "0/m/w"]) == 0
+    assert "array" in capsys.readouterr().out
+
+
+def test_cli_verify_clean_and_corrupt(snap_path, capsys) -> None:
+    assert main(["verify", snap_path]) == 0
+    assert "clean" in capsys.readouterr().out
+    victim = os.path.join(snap_path, "0", "m", "w")
+    data = bytearray(open(victim, "rb").read())
+    data[0] ^= 0xFF
+    open(victim, "wb").write(bytes(data))
+    assert main(["verify", snap_path]) == 1
+    assert "crc mismatch" in capsys.readouterr().err
+
+
+def test_cli_errors_are_clean(snap_path, capsys) -> None:
+    assert main(["cat", snap_path, "0/m/nope"]) == 2
+    assert capsys.readouterr().err.startswith("error:")
+    assert main(["cat", snap_path, "notarank/x"]) == 2
+    assert capsys.readouterr().err.startswith("error:")
