@@ -53,3 +53,17 @@ def test_cli_errors_are_clean(snap_path, capsys) -> None:
     assert capsys.readouterr().err.startswith("error:")
     assert main(["cat", snap_path, "notarank/x"]) == 2
     assert capsys.readouterr().err.startswith("error:")
+
+
+def test_cli_ls_shows_chunk_locations(tmp_path, capsys) -> None:
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = str(tmp_path / "chunked")
+    with _knobs.override_max_chunk_size_bytes(64):
+        Snapshot.take(
+            path, {"m": StateDict(big=np.arange(100, dtype=np.float32))}
+        )
+    assert main(["ls", path]) == 0
+    out = capsys.readouterr().out
+    (line,) = [l for l in out.splitlines() if "0/m/big" in l]
+    assert "@" in line  # chunked entries list member locations

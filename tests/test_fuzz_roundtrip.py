@@ -3,6 +3,8 @@ take -> restore bit-exactly (flatten/inflate + every preparer, reference
 model: the per-component unit tests, but composed randomly).
 """
 
+import copy
+
 import numpy as np
 import pytest
 
@@ -52,7 +54,9 @@ def test_random_state_roundtrip(tmp_path, seed) -> None:
     sd = StateDict(
         **{f"k{i}": _random_value(rng, 0) for i in range(int(rng.integers(1, 8)))}
     )
-    expected = dict(sd)
+    # Deep copy: a take() that mutated source arrays in place would
+    # otherwise corrupt both sides of the comparison identically.
+    expected = copy.deepcopy(dict(sd))
     path = str(tmp_path / "ckpt")
     # Exercise chunking/batching paths on alternate seeds.
     if seed % 2:

@@ -121,6 +121,10 @@ def test_elastic_scale_down_to_one(tmp_path) -> None:
     from torchsnapshot_tpu import Snapshot, StateDict
 
     path = os.path.join(str(tmp_path), "ckpt_elastic")
+    # Both ranks wrote checksum sidecars; the audit covers the whole snapshot.
+    assert os.path.exists(os.path.join(path, ".checksums.0"))
+    assert os.path.exists(os.path.join(path, ".checksums.1"))
+    assert Snapshot(path).verify() == {}
     # Single-process restore of replicated values (new world size = 1).
     tgt = StateDict(w=np.zeros(10, dtype=np.float32), epoch=0)
     Snapshot(path).restore({"repl": tgt})

@@ -35,14 +35,29 @@ def _cmd_ls(args: argparse.Namespace) -> int:
         shape = getattr(entry, "shape", None)
         if dtype is not None and shape is not None:
             detail = f" {dtype}{list(shape)}"
-        loc = getattr(entry, "location", "")
-        if loc:
-            detail += f" @ {loc}"
-            byte_range = getattr(entry, "byte_range", None)
-            if byte_range:
-                detail += f"[{byte_range[0]}:{byte_range[1]}]"
+        detail += _locations_detail(entry)
         print(f"{key}  [{kind}]{detail}")
     return 0
+
+
+def _locations_detail(entry) -> str:
+    """Storage location(s): on the entry itself for plain arrays/objects,
+    per-member for chunked/sharded entries."""
+    loc = getattr(entry, "location", "")
+    if loc:
+        detail = f" @ {loc}"
+        byte_range = getattr(entry, "byte_range", None)
+        if byte_range:
+            detail += f"[{byte_range[0]}:{byte_range[1]}]"
+        return detail
+    members = [
+        m.tensor.location
+        for m in (getattr(entry, "chunks", None) or getattr(entry, "shards", None) or [])
+    ]
+    if not members:
+        return ""
+    extra = f" (+{len(members) - 2} more)" if len(members) > 2 else ""
+    return f" @ {', '.join(members[:2])}{extra}"
 
 
 def _cmd_cat(args: argparse.Namespace) -> int:
@@ -92,11 +107,16 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     try:
         return args.fn(args)
-    except (KeyError, ValueError, RuntimeError, FileNotFoundError) as e:
-        # Predictable operator mistakes (bad object path, checksum-less
-        # snapshot, missing snapshot) exit with a one-line error, not a
-        # traceback — keep the tool scriptable.
-        print(f"error: {e}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - operator tool: scriptable errors
+        # Any failure (bad object path, checksum-less snapshot, missing
+        # snapshot, cloud NotFound/auth errors) exits 2 with a one-line
+        # message, never a traceback — exit 1 is reserved for "verify found
+        # problems". Set TORCHSNAPSHOT_TPU_CLI_TRACEBACK=1 to debug.
+        import os
+
+        if os.environ.get("TORCHSNAPSHOT_TPU_CLI_TRACEBACK"):
+            raise
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return 2
 
 
