@@ -4,6 +4,7 @@
 from collections import OrderedDict
 
 import numpy as np
+import pytest
 
 from torchsnapshot_tpu.flatten import flatten, inflate
 
@@ -73,3 +74,41 @@ def test_leaf_at_root() -> None:
     manifest, flattened = flatten(42, prefix="x")
     assert manifest == {} and flattened == {"x": 42}
     assert inflate(manifest, flattened, prefix="x") == 42
+
+
+def test_empty_string_key_keeps_dict_opaque(tmp_path) -> None:
+    """An empty key would leave an empty logical-path segment (a storage
+    path ending in "/"); such dicts stay opaque and round-trip whole."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.flatten import flatten
+
+    state = {"outer": {"": np.arange(3), "ok": 1}}
+    manifest, flattened = flatten(state)
+    assert "outer" in flattened  # kept as a single opaque leaf
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(**state)})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert np.array_equal(out["outer"][""], np.arange(3))
+    assert out["outer"]["ok"] == 1
+
+
+@pytest.mark.parametrize("key", [".", ".."])
+def test_dot_keys_keep_dict_opaque(tmp_path, key) -> None:
+    """"." and ".." keys would collapse filesystem storage paths; such
+    dicts stay opaque and round-trip whole."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.flatten import flatten
+
+    state = {"outer": {key: np.arange(4), "ok": 1}}
+    _, flattened = flatten(state)
+    assert "outer" in flattened
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(**state)})
+    out = StateDict()
+    Snapshot(path).restore({"s": out})
+    assert np.array_equal(out["outer"][key], np.arange(4))

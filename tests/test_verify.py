@@ -100,3 +100,11 @@ def test_verify_flags_uncovered_manifest_objects(tmp_path) -> None:
     problems = Snapshot(path).verify()
     assert problems.get(dropped) == "unverified (no checksum recorded)"
     assert all(p == dropped for p in problems)
+
+
+def test_verify_all_primitive_snapshot_is_clean(tmp_path) -> None:
+    """A snapshot of only primitives writes no storage objects and no
+    sidecars; verify() reports it trivially clean rather than erroring."""
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(lr=0.1, name="adam", step=3)})
+    assert Snapshot(path).verify() == {}

@@ -8,10 +8,11 @@ each leaf to a ``/``-separated logical path, recording container entries in a
 manifest so :func:`inflate` can rebuild the exact original structure.
 
 Escaping follows the reference's RFC-3986 style: ``%`` -> ``%25`` and ``/`` ->
-``%2F`` in key components. Dicts whose keys are not all ``str``/``int``, or
-whose keys collide after stringification (e.g. ``1`` vs ``"1"``), are kept as
-opaque leaves (pickled whole) rather than descended into (reference
-``flatten.py:142-154``).
+``%2F`` in key components. Dicts whose keys are not all ``str``/``int``,
+whose keys collide after stringification (e.g. ``1`` vs ``"1"``), or that
+contain an empty-string key (which would leave an empty logical-path
+segment) are kept as opaque leaves (pickled whole) rather than descended
+into (reference ``flatten.py:142-154``).
 
 Note on pytrees: flax/optax states are plain nested dicts, so this covers them
 natively. Arbitrary pytrees can be checkpointed via
@@ -48,6 +49,12 @@ def _dict_is_flattenable(d: Dict[Any, Any]) -> bool:
         if not isinstance(k, (str, int)) or isinstance(k, bool):
             return False
         s = str(k)
+        if not s or s in (".", ".."):
+            # An empty key leaves an empty logical-path segment (a storage
+            # path ending in "/"); "." and ".." collapse filesystem paths
+            # (e.g. "a/../b" escaping the entry's directory). Keep such
+            # dicts opaque.
+            return False
         if s in seen:
             return False  # e.g. 1 vs "1" collide after stringification
         seen.add(s)

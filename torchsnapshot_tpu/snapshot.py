@@ -452,8 +452,10 @@ class Snapshot:
 
         Returns a ``{storage_path: problem}`` dict: ``"missing"`` for
         objects that can't be read, ``"crc mismatch (...)"`` for corrupted
-        bytes. Empty dict == clean. Raises ``RuntimeError`` if the snapshot
-        has no checksum sidecars at all (taken with checksums disabled).
+        bytes. Empty dict == clean. Raises ``RuntimeError`` if the manifest
+        references storage objects but no checksum sidecar exists (taken
+        with checksums disabled); a snapshot of only inline primitives has
+        no objects to audit and returns clean.
 
         Beyond the reference's capability surface: it has no integrity
         audit; this one enables post-transfer/post-incident validation
@@ -482,7 +484,12 @@ class Snapshot:
                     continue
                 sidecars += 1
                 expected.update(_json.loads(read_io.buf.getvalue().decode()))
+            manifest_locations = _manifest_storage_locations(metadata.manifest)
             if not sidecars:
+                if not manifest_locations:
+                    # All-primitive snapshot: no storage objects were ever
+                    # written, so there is nothing to audit — trivially clean.
+                    return {}
                 raise RuntimeError(
                     "snapshot has no checksum sidecars (taken with "
                     "TORCHSNAPSHOT_TPU_CHECKSUMS=0?); nothing to verify"
@@ -491,7 +498,7 @@ class Snapshot:
             # Coverage cross-check: every storage object the manifest points
             # at must carry a recorded checksum, else a lost sidecar would
             # yield a false "clean".
-            for location in sorted(_manifest_storage_locations(metadata.manifest)):
+            for location in sorted(manifest_locations):
                 if location not in expected:
                     problems[location] = "unverified (no checksum recorded)"
 
