@@ -116,6 +116,13 @@ class StoragePlugin(abc.ABC):
     async def delete(self, path: str) -> None:
         ...
 
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        """Optionally alias an existing file at absolute ``src_abs_path``
+        into this store at ``path`` without copying bytes (incremental
+        snapshots). Returns False when unsupported or failed — the caller
+        falls back to a normal write. Default: unsupported."""
+        return False
+
     async def close(self) -> None:
         pass
 

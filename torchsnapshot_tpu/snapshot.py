@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import fnmatch
 import logging
+import os
 import threading
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -107,13 +108,22 @@ class Snapshot:
         app_state: AppState,
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
+        base: Optional[str] = None,
     ) -> "Snapshot":
+        """``base``: path of an earlier snapshot for an INCREMENTAL take —
+        storage objects byte-identical to the base (matched by size +
+        sha256 from its checksum sidecars) are hard-linked instead of
+        rewritten (filesystem storage; other backends fall back to full
+        writes). Hard links share inodes, so the base may be deleted later
+        without invalidating this snapshot. Near-free checkpoints when most
+        state is frozen (LoRA/partial finetunes, embedding-heavy models)."""
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(coordinator)
         path, replicated_globs = cls._coalesce_path_and_replicated(
             path, coord, replicated or []
         )
+        base = cls._coalesce_base(base, coord)
         storage = url_to_storage_plugin_in_event_loop(path, event_loop)
         try:
             pending_io_work, metadata = cls._take_impl(
@@ -124,6 +134,7 @@ class Snapshot:
                 storage=storage,
                 event_loop=event_loop,
                 is_async_snapshot=False,
+                base=base,
             )
             pending_io_work.sync_complete(event_loop)
             # Commit metadata only after ALL ranks finished writing data.
@@ -148,6 +159,7 @@ class Snapshot:
         app_state: AppState,
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
+        base: Optional[str] = None,
     ) -> "PendingSnapshot":
         """Returns after planning + forking device buffers (milliseconds);
         device→host transfer, storage I/O, and the atomic commit all happen on
@@ -165,6 +177,7 @@ class Snapshot:
         path, replicated_globs = cls._coalesce_path_and_replicated(
             path, coord, replicated or []
         )
+        base = cls._coalesce_base(base, coord)
         storage = url_to_storage_plugin_in_event_loop(path, event_loop)
         try:
             pending_io_work, metadata = cls._take_impl(
@@ -175,6 +188,7 @@ class Snapshot:
                 storage=storage,
                 event_loop=event_loop,
                 is_async_snapshot=True,
+                base=base,
             )
         except BaseException:
             # On planning/staging failure no PendingSnapshot exists to own
@@ -201,6 +215,7 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         is_async_snapshot: bool,
+        base: Optional[str] = None,
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         rank = coord.get_rank()
         world_size = coord.get_world_size()
@@ -275,6 +290,25 @@ class Snapshot:
         )
 
         memory_budget = get_process_memory_budget_bytes(coord)
+        if base and not knobs.is_checksums_enabled():
+            logger.warning(
+                "base=%s ignored: incremental dedup requires checksums "
+                "(TORCHSNAPSHOT_TPU_CHECKSUMS=0 is set) — taking a full "
+                "snapshot", base
+            )
+            base = None
+
+        base_loader = None
+        if base:
+            # Resolved lazily on the pipeline (for async takes: on the
+            # background drain), so reading the base's metadata + sidecars
+            # never extends async_take's size-independent stall.
+            def base_loader(base=base):
+                loop = asyncio.new_event_loop()
+                try:
+                    return cls._load_base_digests(base, loop)
+                finally:
+                    loop.close()
         # Runs to the capture point: mutable host state is staged into
         # private buffers; device-array staging is deferred for async
         # snapshots (immutable + defensively forked), so the async stall is
@@ -286,6 +320,7 @@ class Snapshot:
             memory_budget_bytes=memory_budget,
             rank=rank,
             event_loop=event_loop,
+            base_loader=base_loader,
         )
 
         # Reinstate the pre-take RNG state (taking a snapshot must not
@@ -293,6 +328,55 @@ class Snapshot:
         for _, stateful, state in rng_states:
             stateful.load_state_dict(state)
         return pending_io_work, metadata
+
+    @classmethod
+    def _load_base_digests(
+        cls, base: str, event_loop: asyncio.AbstractEventLoop
+    ) -> Optional[Tuple[str, Dict[str, list]]]:
+        """(base root, merged {storage_path: [crc, size, sha256]}) for an
+        incremental take, or None when the base can't serve as one (non-FS
+        URL, uncommitted, or pre-digest sidecars) — the take then proceeds
+        as a full snapshot."""
+        import json as _json
+
+        from .scheduler import CHECKSUM_FILE_PREFIX
+
+        root = base[len("fs://") :] if base.startswith("fs://") else base
+        if "://" in root:
+            logger.warning(
+                "base=%s is not filesystem storage; incremental hard-linking "
+                "is unsupported there — taking a full snapshot", base
+            )
+            return None
+        storage = url_to_storage_plugin_in_event_loop(base, event_loop)
+        try:
+            try:
+                metadata = cls(base)._read_metadata(storage, event_loop)
+            except Exception:
+                logger.warning(
+                    "base=%s has no committed metadata; taking a full snapshot",
+                    base,
+                )
+                return None
+            digests: Dict[str, list] = {}
+            for rank in range(metadata.world_size):
+                read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
+                try:
+                    storage.sync_read(read_io, event_loop)
+                except Exception:
+                    continue
+                for k, v in _json.loads(read_io.buf.getvalue().decode()).items():
+                    if isinstance(v, list) and len(v) == 3:
+                        digests[k] = v
+            if not digests:
+                logger.warning(
+                    "base=%s carries no digest sidecars; taking a full snapshot",
+                    base,
+                )
+                return None
+            return os.path.abspath(root), digests
+        finally:
+            storage.sync_close(event_loop)
 
     # --------------------------------------------------------------- restore
     def restore(self, app_state: AppState) -> None:
@@ -515,9 +599,12 @@ class Snapshot:
                             problems[path] = "missing"
                             return
                         got = _zlib.crc32(read_io.buf.getbuffer())
-                        if got != want:
+                        # Sidecar value: bare crc int (pre-digest snapshots)
+                        # or [crc, size, sha256] (current format).
+                        want_crc = want if isinstance(want, int) else want[0]
+                        if got != want_crc:
                             problems[path] = (
-                                f"crc mismatch (recorded {want}, found {got})"
+                                f"crc mismatch (recorded {want_crc}, found {got})"
                             )
 
                 await asyncio.gather(
@@ -620,6 +707,22 @@ class Snapshot:
         if dropped:
             logger.warning("Ignoring rank-asymmetric replicated globs: %s", dropped)
         return paths[0], sorted(common)
+
+    @staticmethod
+    def _coalesce_base(base: Optional[str], coord: Coordinator) -> Optional[str]:
+        """Rank 0's ``base`` wins (warn on divergence) — a rank-divergent
+        base (e.g. locally formatted timestamps) would silently degrade the
+        divergent ranks to full writes."""
+        if coord.get_world_size() == 1:
+            return base
+        bases = coord.all_gather_object(base)
+        if any(b != bases[0] for b in bases):
+            logger.warning(
+                "Rank-divergent base snapshots %s; using rank 0's: %s",
+                bases,
+                bases[0],
+            )
+        return bases[0]
 
     @staticmethod
     def _match_replicated_paths(paths: Set[str], globs: List[str]) -> Set[str]:

@@ -101,6 +101,24 @@ class FSStoragePlugin(StoragePlugin):
                 os.remove(tmp_path)
             raise
 
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        """Hard-link ``src_abs_path`` to ``path`` (atomically, via a temp
+        name + rename). Fails soft — cross-device links, a deleted base, or
+        an exotic filesystem all return False and the caller writes the
+        bytes instead. Hard links share the inode, so deleting the base
+        snapshot later does NOT invalidate this one."""
+        dst = os.path.join(self.root, path)
+        self._ensure_parent(dst)
+        tmp = f"{dst}.tmp.{uuid.uuid4().hex[:8]}"
+        try:
+            os.link(src_abs_path, tmp)
+            os.replace(tmp, dst)
+            return True
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            return False
+
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
         if read_io.byte_range is not None:
