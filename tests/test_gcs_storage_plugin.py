@@ -40,11 +40,17 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
             del blobs[self._name]
 
     class FakeBucket:
+        copies: list = []  # (src_name, dst_name) server-side copies
+
         def __init__(self, name: str) -> None:
             self.name = name
 
         def blob(self, path: str) -> FakeBlob:
             return FakeBlob(path)
+
+        def copy_blob(self, src_blob, dst_bucket, new_name: str) -> None:
+            blobs[new_name] = blobs[src_blob._name]
+            FakeBucket.copies.append((src_blob._name, new_name))
 
     class FakeClient:
         def bucket(self, name: str) -> FakeBucket:
@@ -244,3 +250,33 @@ def test_live_snapshot_roundtrip(tmp_path) -> None:
     out = {"s": StateDict(arr=np.zeros(1024, dtype=np.float32))}
     Snapshot(path).restore(out)
     assert np.array_equal(out["s"]["arr"], arr)
+
+
+def test_incremental_take_uses_server_side_copies(fake_gcs, monkeypatch) -> None:
+    """take(base=gs://...) dedups via GCS server-side copies: unchanged
+    objects are copied bucket-side, never re-uploaded from this host."""
+    import sys as _sys
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    blobs, _ = fake_gcs
+    fake_bucket_cls = type(
+        _sys.modules["google.cloud.storage"].Client().bucket("bucket")
+    )
+    fake_bucket_cls.copies.clear()
+    frozen = {f"b{i}": np.arange(500, dtype=np.float32) + i for i in range(3)}
+
+    def app(step):
+        return {"m": StateDict(**frozen, head=np.full((10,), step, np.float32))}
+
+    Snapshot.take("gs://bucket/s0", app(0))
+    Snapshot.take("gs://bucket/s1", app(1), base="gs://bucket/s0")
+    copied_dsts = {dst for _, dst in fake_bucket_cls.copies}
+    assert {f"s1/0/m/b{i}" for i in range(3)} <= copied_dsts
+    assert "s1/0/m/head" not in copied_dsts  # changed: re-uploaded
+    out = StateDict()
+    Snapshot("gs://bucket/s1").restore({"m": out})
+    assert np.array_equal(out["head"], np.full((10,), 1, np.float32))
+    assert np.array_equal(out["b2"], frozen["b2"])

@@ -104,3 +104,36 @@ def test_incremental_identical_state_links_everything(tmp_path) -> None:
         n = os.stat(os.path.join(inc, "0", "m", name))
         assert b.st_ino == n.st_ino, name
     assert Snapshot(inc).verify() == {}
+
+
+def test_invalid_base_never_aborts_take(tmp_path, caplog) -> None:
+    """A typo'd/unsupported base URL must warn and fall back to a full
+    snapshot — never fail the checkpoint itself."""
+    path = str(tmp_path / "ckpt")
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.snapshot"):
+        Snapshot.take(path, {"m": _state(0)}, base="foo://not/a/thing")
+    assert any("full snapshot" in r.message for r in caplog.records)
+    out = StateDict()
+    Snapshot(path).restore({"m": out})
+    assert out["step"] == 0
+
+
+def test_dedup_digests_knob_off_skips_sha_and_dedup(tmp_path) -> None:
+    """With dedup digests off, sidecars record [crc, size, None]; such a
+    base warns and the take stays full (no links), but verify still works."""
+    import json
+
+    base = str(tmp_path / "a")
+    inc = str(tmp_path / "b")
+    with knobs.override_dedup_digests(False):
+        Snapshot.take(base, {"m": _state(0)})
+        recorded = json.loads(
+            open(os.path.join(base, ".checksums.0")).read()
+        )
+        assert all(v[2] is None for v in recorded.values())
+        Snapshot.take(inc, {"m": _state(0)}, base=base)
+    b = os.stat(os.path.join(base, "0", "m", "frozen0"))
+    n = os.stat(os.path.join(inc, "0", "m", "frozen0"))
+    assert b.st_ino != n.st_ino  # no links without digests
+    assert Snapshot(base).verify() == {}
+    assert Snapshot(inc).verify() == {}

@@ -58,14 +58,18 @@ CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
 
 def _digest_buffer(mv: memoryview) -> list:
-    """[crc32, size, sha256 hex] of one staged buffer. crc feeds
+    """[crc32, size, sha256-hex | None] of one staged buffer. crc feeds
     Snapshot.verify(); (size, sha256) is the dedup identity for incremental
-    snapshots (collision-resistant, unlike crc). sha256 over blake2b:
+    snapshots (collision-resistant, unlike crc) and can be knobbed off on
+    CPU-tight hosts that never pass ``base=``. sha256 over blake2b:
     OpenSSL's implementation is ~2x faster per core here and releases the
     GIL for large buffers, so the hash pool scales on multi-core hosts."""
-    h = hashlib.sha256()
-    h.update(mv)
-    return [zlib.crc32(mv), mv.nbytes, h.hexdigest()]
+    sha = None
+    if knobs.is_dedup_digests_enabled():
+        h = hashlib.sha256()
+        h.update(mv)
+        sha = h.hexdigest()
+    return [zlib.crc32(mv), mv.nbytes, sha]
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
@@ -223,9 +227,10 @@ class _WritePipeline:
 
     async def _write_one(self, path: str, buf) -> None:
         if knobs.is_checksums_enabled():
-            # Hashing releases the GIL; it runs on a small DEDICATED pool so
-            # a staging pool saturated with multi-second D2H jobs can't
-            # head-of-line block storage writes behind queued staging work.
+            # Hashing releases the GIL; it runs on its own pool (width =
+            # staging threads) so a staging pool saturated with multi-second
+            # D2H jobs can't head-of-line block storage writes behind queued
+            # staging work.
             # Recorded per *storage object* (sidecar value
             # [crc32, size, sha256]) so ``Snapshot.verify()`` can audit
             # files without the manifest and incremental takes can dedup.
@@ -254,6 +259,7 @@ class _WritePipeline:
                 if (
                     isinstance(rec, list)
                     and len(rec) == 3
+                    and digest[2] is not None
                     and rec[1] == digest[1]
                     and rec[2] == digest[2]
                 ):

@@ -112,11 +112,12 @@ class Snapshot:
     ) -> "Snapshot":
         """``base``: path of an earlier snapshot for an INCREMENTAL take —
         storage objects byte-identical to the base (matched by size +
-        sha256 from its checksum sidecars) are hard-linked instead of
-        rewritten (filesystem storage; other backends fall back to full
-        writes). Hard links share inodes, so the base may be deleted later
-        without invalidating this snapshot. Near-free checkpoints when most
-        state is frozen (LoRA/partial finetunes, embedding-heavy models)."""
+        sha256 from its checksum sidecars) are hard-linked (filesystem) or
+        server-side copied (GCS/S3) instead of rewritten; any failure falls
+        back to a full write. Hard links share inodes, so the base may be
+        deleted later without invalidating this snapshot. Near-free
+        checkpoints when most state is frozen (LoRA/partial finetunes,
+        embedding-heavy models)."""
         cls._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(coordinator)
@@ -307,6 +308,13 @@ class Snapshot:
                 loop = asyncio.new_event_loop()
                 try:
                     return cls._load_base_digests(base, loop)
+                except Exception:  # never abort the take over a bad base
+                    logger.warning(
+                        "base=%s digest load failed; taking a full snapshot",
+                        base,
+                        exc_info=True,
+                    )
+                    return None
                 finally:
                     loop.close()
         # Runs to the capture point: mutable host state is staged into
@@ -334,21 +342,33 @@ class Snapshot:
         cls, base: str, event_loop: asyncio.AbstractEventLoop
     ) -> Optional[Tuple[str, Dict[str, list]]]:
         """(base root, merged {storage_path: [crc, size, sha256]}) for an
-        incremental take, or None when the base can't serve as one (non-FS
-        URL, uncommitted, or pre-digest sidecars) — the take then proceeds
-        as a full snapshot."""
+        incremental take, or None when the base can't serve as one
+        (uncommitted, or pre-digest sidecars) — the take then proceeds as a
+        full snapshot.
+
+        The root is an absolute filesystem path for local/``fs://`` bases
+        (dedup = hard links) and the original URL for cloud bases (dedup =
+        server-side copies via the target plugin's ``link_in``); a
+        base/target storage mismatch simply makes every ``link_in`` refuse
+        and the take falls back to full writes."""
         import json as _json
 
         from .scheduler import CHECKSUM_FILE_PREFIX
 
         root = base[len("fs://") :] if base.startswith("fs://") else base
-        if "://" in root:
+        if "://" not in root:
+            root = os.path.abspath(root)
+        try:
+            storage = url_to_storage_plugin_in_event_loop(base, event_loop)
+        except Exception:
+            # An unusable base (bad URL/scheme, missing SDK, absent
+            # credentials) must never abort the checkpoint itself.
             logger.warning(
-                "base=%s is not filesystem storage; incremental hard-linking "
-                "is unsupported there — taking a full snapshot", base
+                "base=%s is unusable; taking a full snapshot",
+                base,
+                exc_info=True,
             )
             return None
-        storage = url_to_storage_plugin_in_event_loop(base, event_loop)
         try:
             try:
                 metadata = cls(base)._read_metadata(storage, event_loop)
@@ -374,7 +394,7 @@ class Snapshot:
                     base,
                 )
                 return None
-            return os.path.abspath(root), digests
+            return root, digests
         finally:
             storage.sync_close(event_loop)
 

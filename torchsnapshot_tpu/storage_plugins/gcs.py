@@ -128,6 +128,33 @@ class GCSStoragePlugin(StoragePlugin):
         blob = self._bucket.blob(self._blob_path(path))
         await self._retrying(blob.delete)
 
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        """Server-side copy from a base snapshot (incremental takes): a GCS
+        rewrite moves no bytes through this host. ``src_abs_path`` is the
+        base object's full ``gs://bucket/...`` URL; only same-provider
+        sources are supported (cross-bucket works — rewrites are
+        server-side either way)."""
+        if not src_abs_path.startswith("gs://"):
+            return False
+        src_bucket_name, _, src_key = src_abs_path[len("gs://") :].partition("/")
+        try:
+            src_bucket = self._client.bucket(src_bucket_name)
+            src_blob = src_bucket.blob(src_key)
+            dst_name = self._blob_path(path)
+
+            def copy() -> None:
+                src_bucket.copy_blob(src_blob, self._bucket, dst_name)
+
+            await self._retrying(copy)
+            return True
+        except Exception:
+            logger.warning(
+                "Server-side copy of %s failed; rewriting the object",
+                src_abs_path,
+                exc_info=True,
+            )
+            return False
+
     async def close(self) -> None:
         self._executor.shutdown(wait=True)
 

@@ -9,9 +9,12 @@ The SDK (aioboto3/aiobotocore) import is lazy and gated with a clear error.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
 
 
 class S3StoragePlugin(StoragePlugin):
@@ -64,6 +67,29 @@ class S3StoragePlugin(StoragePlugin):
     async def delete(self, path: str) -> None:
         client = await self._get_client()
         await client.delete_object(Bucket=self.bucket, Key=self._key(path))
+
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        """Server-side CopyObject from a base snapshot (incremental takes):
+        no bytes move through this host. ``src_abs_path`` is the base
+        object's full ``s3://bucket/...`` URL."""
+        if not src_abs_path.startswith("s3://"):
+            return False
+        src_bucket, _, src_key = src_abs_path[len("s3://") :].partition("/")
+        try:
+            client = await self._get_client()
+            await client.copy_object(
+                Bucket=self.bucket,
+                Key=self._key(path),
+                CopySource={"Bucket": src_bucket, "Key": src_key},
+            )
+            return True
+        except Exception:
+            logger.warning(
+                "Server-side copy of %s failed; rewriting the object",
+                src_abs_path,
+                exc_info=True,
+            )
+            return False
 
     async def close(self) -> None:
         if self._client_ctx is not None:
