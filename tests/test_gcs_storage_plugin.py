@@ -39,6 +39,16 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
         def delete(self) -> None:
             del blobs[self._name]
 
+        def rewrite(self, src_blob, token=None):
+            # One-token resumable rewrite: first call returns a token (as
+            # real GCS does for large objects), the second completes.
+            if token is None:
+                return ("resume-token", 0, len(blobs[src_blob._name]))
+            blobs[self._name] = blobs[src_blob._name]
+            FakeBucket.copies.append((src_blob._name, self._name))
+            n = len(blobs[self._name])
+            return (None, n, n)
+
     class FakeBucket:
         copies: list = []  # (src_name, dst_name) server-side copies
 
@@ -47,10 +57,6 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
 
         def blob(self, path: str) -> FakeBlob:
             return FakeBlob(path)
-
-        def copy_blob(self, src_blob, dst_bucket, new_name: str) -> None:
-            blobs[new_name] = blobs[src_blob._name]
-            FakeBucket.copies.append((src_blob._name, new_name))
 
     class FakeClient:
         def bucket(self, name: str) -> FakeBucket:

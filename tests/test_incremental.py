@@ -137,3 +137,41 @@ def test_dedup_digests_knob_off_skips_sha_and_dedup(tmp_path) -> None:
     assert b.st_ino != n.st_ino  # no links without digests
     assert Snapshot(base).verify() == {}
     assert Snapshot(inc).verify() == {}
+
+
+def test_incremental_dedups_batched_slabs_by_content(tmp_path) -> None:
+    """Slab objects get fresh batched/<uuid> paths every take; identical
+    slab bytes must still dedup via the content-keyed index."""
+    base = str(tmp_path / "a")
+    inc = str(tmp_path / "b")
+    arrs = {f"p{i}": np.arange(50, dtype=np.float32) + i for i in range(10)}
+    with knobs.override_batching_enabled(True):
+        Snapshot.take(base, {"m": StateDict(**arrs)})
+        Snapshot.take(inc, {"m": StateDict(**arrs)}, base=base)
+    import glob as _glob
+
+    (base_slab,) = _glob.glob(os.path.join(base, "batched", "*"))
+    (inc_slab,) = _glob.glob(os.path.join(inc, "batched", "*"))
+    assert os.path.basename(base_slab) != os.path.basename(inc_slab)
+    assert os.stat(base_slab).st_ino == os.stat(inc_slab).st_ino  # linked
+    out = StateDict()
+    Snapshot(inc).restore({"m": out})
+    assert np.array_equal(out["p7"], arrs["p7"])
+    assert Snapshot(inc).verify() == {}
+
+
+def test_chained_incrementals(tmp_path) -> None:
+    """s0 -> s1 -> s2: each step links unchanged objects against its direct
+    predecessor; all restore bit-exactly and verify clean."""
+    paths = [str(tmp_path / f"s{i}") for i in range(3)]
+    Snapshot.take(paths[0], {"m": _state(0)})
+    Snapshot.take(paths[1], {"m": _state(1)}, base=paths[0])
+    Snapshot.take(paths[2], {"m": _state(2)}, base=paths[1])
+    inos = [os.stat(os.path.join(p, "0", "m", "frozen0")).st_ino for p in paths]
+    assert inos[0] == inos[1] == inos[2]
+    for step, p in enumerate(paths):
+        out = StateDict()
+        Snapshot(p).restore({"m": out})
+        assert out["step"] == step
+        assert np.array_equal(out["lora"], np.full((100,), step, np.float32))
+        assert Snapshot(p).verify() == {}

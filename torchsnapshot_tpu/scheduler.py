@@ -159,11 +159,12 @@ class _WritePipeline:
         self.storage = storage
         # Resolved lazily (on the background drain for async takes) so
         # reading the base snapshot's metadata/sidecars never extends
-        # async_take's stall; base == the loader's (root, digests) or None.
+        # async_take's stall; after resolution base is
+        # (root, {path: digest}, {(size, sha): path}) or None.
         self._base_loader = base_loader
         self._base_resolved = base_loader is None
         self._base_lock = asyncio.Lock()
-        self.base: Optional[Tuple[str, Dict[str, list]]] = None
+        self.base = None
         self.bytes_deduped = 0
         self.rank = rank
         self.begin_ts = time.monotonic()
@@ -252,22 +253,41 @@ class _WritePipeline:
                         self.base = await loop.run_in_executor(
                             self._crc_executor, self._base_loader
                         )
+                        if self.base is not None:
+                            # Content-keyed inverted index: lets an object
+                            # dedup against a base object at a DIFFERENT
+                            # path — e.g. batched slabs, whose
+                            # ``batched/<uuid>`` paths are fresh each take
+                            # even when their bytes are identical.
+                            root, digests = self.base
+                            by_content = {
+                                (v[1], v[2]): k
+                                for k, v in digests.items()
+                                if isinstance(v, list)
+                                and len(v) == 3
+                                and v[2] is not None
+                            }
+                            self.base = (root, digests, by_content)
                         self._base_resolved = True
-            if self.base is not None:
-                base_root, base_digests = self.base
+            if self.base is not None and digest[2] is not None:
+                base_root, base_digests, by_content = self.base
                 rec = base_digests.get(path)
+                src_path = None
                 if (
                     isinstance(rec, list)
                     and len(rec) == 3
-                    and digest[2] is not None
                     and rec[1] == digest[1]
                     and rec[2] == digest[2]
                 ):
-                    # Byte-identical to the base snapshot's object
-                    # (size + sha256 match): hard-link instead of
-                    # rewriting. Any link failure (cross-device, base
-                    # deleted, non-FS backend) falls back to a write.
-                    src = os.path.join(base_root, path)
+                    src_path = path
+                else:
+                    src_path = by_content.get((digest[1], digest[2]))
+                if src_path is not None:
+                    # Byte-identical to a base snapshot object (size +
+                    # sha256 match): hard-link / server-side copy instead
+                    # of rewriting. Any failure (cross-device, base
+                    # deleted, backend mismatch) falls back to a write.
+                    src = os.path.join(base_root, src_path)
                     if await self.storage.link_in(src, path):
                         self.bytes_deduped += digest[1]
                         return
@@ -352,7 +372,7 @@ class _WritePipeline:
         elapsed = time.monotonic() - self.begin_ts
         if self.bytes_staged:
             dedup = (
-                f" ({self.bytes_deduped / 1e9:.2f} GB hard-linked from base)"
+                f" ({self.bytes_deduped / 1e9:.2f} GB deduped from base)"
                 if self.bytes_deduped
                 else ""
             )

@@ -291,10 +291,13 @@ class Snapshot:
         )
 
         memory_budget = get_process_memory_budget_bytes(coord)
-        if base and not knobs.is_checksums_enabled():
+        if base and not (
+            knobs.is_checksums_enabled() and knobs.is_dedup_digests_enabled()
+        ):
             logger.warning(
-                "base=%s ignored: incremental dedup requires checksums "
-                "(TORCHSNAPSHOT_TPU_CHECKSUMS=0 is set) — taking a full "
+                "base=%s ignored: incremental dedup requires checksums and "
+                "dedup digests (TORCHSNAPSHOT_TPU_CHECKSUMS / "
+                "TORCHSNAPSHOT_TPU_DEDUP_DIGESTS is off) — taking a full "
                 "snapshot", base
             )
             base = None
@@ -386,7 +389,10 @@ class Snapshot:
                 except Exception:
                     continue
                 for k, v in _json.loads(read_io.buf.getvalue().decode()).items():
-                    if isinstance(v, list) and len(v) == 3:
+                    # Skip sha-less entries (dedup digests were off): an
+                    # all-None base then hits the no-digests warning below
+                    # instead of loading as a silently useless base.
+                    if isinstance(v, list) and len(v) == 3 and v[2] is not None:
                         digests[k] = v
             if not digests:
                 logger.warning(

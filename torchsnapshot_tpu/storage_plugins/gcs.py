@@ -140,10 +140,15 @@ class GCSStoragePlugin(StoragePlugin):
         try:
             src_bucket = self._client.bucket(src_bucket_name)
             src_blob = src_bucket.blob(src_key)
-            dst_name = self._blob_path(path)
+            dst_blob = self._bucket.blob(self._blob_path(path))
 
             def copy() -> None:
-                src_bucket.copy_blob(src_blob, self._bucket, dst_name)
+                # Rewrite (not objects.copy): resumable via token loop, so
+                # multi-GB and cross-location/storage-class copies don't
+                # blow a single-request deadline.
+                token, _, _ = dst_blob.rewrite(src_blob)
+                while token is not None:
+                    token, _, _ = dst_blob.rewrite(src_blob, token=token)
 
             await self._retrying(copy)
             return True

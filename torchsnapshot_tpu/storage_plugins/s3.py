@@ -77,11 +77,16 @@ class S3StoragePlugin(StoragePlugin):
         src_bucket, _, src_key = src_abs_path[len("s3://") :].partition("/")
         try:
             client = await self._get_client()
-            await client.copy_object(
-                Bucket=self.bucket,
-                Key=self._key(path),
-                CopySource={"Bucket": src_bucket, "Key": src_key},
-            )
+            src = {"Bucket": src_bucket, "Key": src_key}
+            if hasattr(client, "copy"):
+                # Managed transfer: multipart UploadPartCopy above the 5 GiB
+                # single-request CopyObject limit — frozen multi-GB shards
+                # are exactly the dedup target.
+                await client.copy(src, self.bucket, self._key(path))
+            else:  # pragma: no cover - minimal clients
+                await client.copy_object(
+                    Bucket=self.bucket, Key=self._key(path), CopySource=src
+                )
             return True
         except Exception:
             logger.warning(
