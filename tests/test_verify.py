@@ -108,3 +108,24 @@ def test_verify_all_primitive_snapshot_is_clean(tmp_path) -> None:
     path = str(tmp_path / "ckpt")
     Snapshot.take(path, {"s": StateDict(lr=0.1, name="adam", step=3)})
     assert Snapshot(path).verify() == {}
+
+
+def test_retake_with_checksums_off_clears_stale_sidecar(tmp_path) -> None:
+    """Re-taking a path with checksums disabled must remove the previous
+    take's sidecar, or verify() would compare stale digests against new
+    bytes and report a healthy snapshot as corrupt."""
+    import shutil
+
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, _app())
+    assert os.path.exists(os.path.join(path, ".checksums.0"))
+    shutil.rmtree(path)
+    os.makedirs(path)
+    # Simulate a stale sidecar surviving (e.g. partial cleanup) alongside a
+    # fresh checksum-less take at the same path.
+    Snapshot.take(path, _app())  # fresh sidecar
+    with knobs.override_checksums(False):
+        Snapshot.take(path, {"s": StateDict(other=np.ones(7))})
+    assert not os.path.exists(os.path.join(path, ".checksums.0"))
+    with pytest.raises(RuntimeError, match="no checksum sidecars"):
+        Snapshot(path).verify()

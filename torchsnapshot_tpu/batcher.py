@@ -123,8 +123,9 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
                 return await super().stage_buffer(executor)
             # Cooldown elapsed: transient causes (a momentary HBM pressure
             # spike at the to_host resolve) deserve another chance; a
-            # deterministic compile failure will just re-memoize.
-            del _PACK_FAILED[key]
+            # deterministic compile failure will just re-memoize. pop, not
+            # del: two concurrently-draining pipelines may race this path.
+            _PACK_FAILED.pop(key, None)
         try:
             packed = _pack_to_device_bytes(key, arrs)
             # to_host wraps the async-hint-then-resolve pattern; a device-side
@@ -137,8 +138,12 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
                     f"planned {self.total}"
                 )
         except Exception:
-            if len(_PACK_FAILED) < _PACK_FAILED_CAP:
-                _PACK_FAILED[key] = time.monotonic()
+            if len(_PACK_FAILED) >= _PACK_FAILED_CAP:
+                # Evict oldest (insertion order) rather than refusing the
+                # insert: a refusing cap would defeat the cooldown and
+                # re-warn on every take once full.
+                _PACK_FAILED.pop(next(iter(_PACK_FAILED)), None)
+            _PACK_FAILED[key] = time.monotonic()
             logger.warning(
                 "On-device slab packing failed; falling back to host-side "
                 "packing for %d members (device path for this slab "

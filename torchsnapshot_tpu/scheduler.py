@@ -367,6 +367,18 @@ class _WritePipeline:
                 await self.storage.write(
                     WriteIO(path=f"{CHECKSUM_FILE_PREFIX}{self.rank}", buf=payload)
                 )
+            elif self.bytes_staged:
+                # This take wrote objects but recorded no checksums
+                # (TORCHSNAPSHOT_TPU_CHECKSUMS=0): remove any stale sidecar a
+                # previous take left at this path, or verify() would compare
+                # the old digests against the new bytes and report a healthy
+                # snapshot as corrupt.
+                try:
+                    await self.storage.delete(
+                        f"{CHECKSUM_FILE_PREFIX}{self.rank}"
+                    )
+                except Exception:
+                    pass  # absent (the common case) or undeletable
         finally:
             self._shutdown_executor()
         elapsed = time.monotonic() - self.begin_ts

@@ -354,10 +354,6 @@ class Snapshot:
         server-side copies via the target plugin's ``link_in``); a
         base/target storage mismatch simply makes every ``link_in`` refuse
         and the take falls back to full writes."""
-        import json as _json
-
-        from .scheduler import CHECKSUM_FILE_PREFIX
-
         root = base[len("fs://") :] if base.startswith("fs://") else base
         if "://" not in root:
             root = os.path.abspath(root)
@@ -381,19 +377,17 @@ class Snapshot:
                     base,
                 )
                 return None
-            digests: Dict[str, list] = {}
-            for rank in range(metadata.world_size):
-                read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
-                try:
-                    storage.sync_read(read_io, event_loop)
-                except Exception:
-                    continue
-                for k, v in _json.loads(read_io.buf.getvalue().decode()).items():
-                    # Skip sha-less entries (dedup digests were off): an
-                    # all-None base then hits the no-digests warning below
-                    # instead of loading as a silently useless base.
-                    if isinstance(v, list) and len(v) == 3 and v[2] is not None:
-                        digests[k] = v
+            merged, _ = _read_checksum_sidecars(
+                storage, metadata.world_size, event_loop
+            )
+            # Skip sha-less entries (dedup digests were off): an all-None
+            # base then hits the no-digests warning below instead of
+            # loading as a silently useless base.
+            digests: Dict[str, list] = {
+                k: v
+                for k, v in merged.items()
+                if isinstance(v, list) and len(v) == 3 and v[2] is not None
+            }
             if not digests:
                 logger.warning(
                     "base=%s carries no digest sidecars; taking a full snapshot",
@@ -571,29 +565,19 @@ class Snapshot:
         audit; this one enables post-transfer/post-incident validation
         without a full restore.
         """
-        import json as _json
         import zlib as _zlib
 
-        from .scheduler import CHECKSUM_FILE_PREFIX
         from .utils import knobs as _knobs
 
         event_loop = asyncio.new_event_loop()
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
         try:
             metadata = self._read_metadata(storage, event_loop)
-            expected: Dict[str, int] = {}
-            sidecars = 0
-            for rank in range(metadata.world_size):
-                read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
-                try:
-                    storage.sync_read(read_io, event_loop)
-                except Exception:
-                    # Can't tell "rank wrote no objects" from "sidecar lost";
-                    # the manifest cross-check below reports that rank's
-                    # objects as unverified either way.
-                    continue
-                sidecars += 1
-                expected.update(_json.loads(read_io.buf.getvalue().decode()))
+            # Can't tell "rank wrote no objects" from "sidecar lost"; the
+            # manifest cross-check below reports uncovered objects either way.
+            expected, sidecars = _read_checksum_sidecars(
+                storage, metadata.world_size, event_loop
+            )
             manifest_locations = _manifest_storage_locations(metadata.manifest)
             if not sidecars:
                 if not manifest_locations:
@@ -613,25 +597,46 @@ class Snapshot:
                     problems[location] = "unverified (no checksum recorded)"
 
             async def check_all() -> None:
-                # Semaphore must be created on the running loop.
+                # Created on the running loop. Concurrency is capped by the
+                # IO knob AND a memory budget: 16 concurrent full-object
+                # reads of 512 MB shards would otherwise buffer ~8 GB — an
+                # OOM on the small operator VMs this audit targets.
                 sem = asyncio.Semaphore(_knobs.get_max_concurrent_io())
+                budget_total = get_process_memory_budget_bytes(None)
+                avail = budget_total
+                cond = asyncio.Condition()
 
-                async def check_one(path: str, want: int) -> None:
-                    async with sem:
-                        read_io = ReadIO(path=path)
-                        try:
-                            await storage.read(read_io)
-                        except Exception:
-                            problems[path] = "missing"
-                            return
-                        got = _zlib.crc32(read_io.buf.getbuffer())
-                        # Sidecar value: bare crc int (pre-digest snapshots)
-                        # or [crc, size, sha256] (current format).
-                        want_crc = want if isinstance(want, int) else want[0]
-                        if got != want_crc:
-                            problems[path] = (
-                                f"crc mismatch (recorded {want_crc}, found {got})"
-                            )
+                async def check_one(path: str, want) -> None:
+                    nonlocal avail
+                    # Recorded size when the sidecar has one; a conservative
+                    # slice of the budget for legacy int-format entries.
+                    cost = want[1] if isinstance(want, list) else budget_total // 8
+                    cost = min(cost, budget_total)  # oversize: admit alone
+                    async with cond:
+                        while avail < cost:
+                            await cond.wait()
+                        avail -= cost
+                    try:
+                        async with sem:
+                            read_io = ReadIO(path=path)
+                            try:
+                                await storage.read(read_io)
+                            except Exception:
+                                problems[path] = "missing"
+                                return
+                            got = _zlib.crc32(read_io.buf.getbuffer())
+                            # Sidecar value: bare crc int (pre-digest
+                            # snapshots) or [crc, size, sha256] (current).
+                            want_crc = want if isinstance(want, int) else want[0]
+                            if got != want_crc:
+                                problems[path] = (
+                                    f"crc mismatch (recorded {want_crc}, "
+                                    f"found {got})"
+                                )
+                    finally:
+                        async with cond:
+                            avail += cost
+                            cond.notify_all()
 
                 await asyncio.gather(
                     *(check_one(p, w) for p, w in sorted(expected.items()))
@@ -791,6 +796,46 @@ class Snapshot:
 # ---------------------------------------------------------------------------
 # Per-entry restore planning shared by restore() and read_object()
 # ---------------------------------------------------------------------------
+
+def _read_checksum_sidecars(
+    storage: StoragePlugin,
+    world_size: int,
+    event_loop: asyncio.AbstractEventLoop,
+) -> Tuple[Dict[str, Any], int]:
+    """Read + merge every rank's ``.checksums.<rank>`` sidecar concurrently.
+
+    Returns (merged {storage_path: digest}, number of sidecars found).
+    Unreadable sidecars are skipped — callers decide what absence means.
+    The single source of truth for sidecar parsing: ``verify()`` and the
+    incremental-base loader must never diverge on the format.
+    """
+    import json as _json
+
+    from .scheduler import CHECKSUM_FILE_PREFIX
+
+    merged: Dict[str, Any] = {}
+    found = 0
+
+    async def read_all() -> None:
+        nonlocal found
+
+        async def read_one(rank: int):
+            read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
+            try:
+                await storage.read(read_io)
+            except Exception:
+                return None
+            return _json.loads(read_io.buf.getvalue().decode())
+
+        results = await asyncio.gather(*(read_one(r) for r in range(world_size)))
+        for r in results:
+            if r is not None:
+                found += 1
+                merged.update(r)
+
+    event_loop.run_until_complete(read_all())
+    return merged, found
+
 
 def _manifest_storage_locations(manifest: Manifest) -> Set[str]:
     """Every storage-object path the manifest points at (slab members share
