@@ -114,18 +114,22 @@ def test_retake_with_checksums_off_clears_stale_sidecar(tmp_path) -> None:
     """Re-taking a path with checksums disabled must remove the previous
     take's sidecar, or verify() would compare stale digests against new
     bytes and report a healthy snapshot as corrupt."""
-    import shutil
-
     path = str(tmp_path / "ckpt")
-    Snapshot.take(path, _app())
+    Snapshot.take(path, _app())  # leaves .checksums.0
     assert os.path.exists(os.path.join(path, ".checksums.0"))
-    shutil.rmtree(path)
-    os.makedirs(path)
-    # Simulate a stale sidecar surviving (e.g. partial cleanup) alongside a
-    # fresh checksum-less take at the same path.
-    Snapshot.take(path, _app())  # fresh sidecar
     with knobs.override_checksums(False):
         Snapshot.take(path, {"s": StateDict(other=np.ones(7))})
     assert not os.path.exists(os.path.join(path, ".checksums.0"))
     with pytest.raises(RuntimeError, match="no checksum sidecars"):
         Snapshot(path).verify()
+
+
+def test_primitive_only_retake_clears_stale_sidecar(tmp_path) -> None:
+    """A re-take that writes ZERO storage objects (primitive-only state,
+    checksums still on) must also clear the stale sidecar — verify() would
+    otherwise report the healthy new snapshot's objects as missing."""
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, _app())  # writes objects + sidecar
+    Snapshot.take(path, {"s": StateDict(lr=0.1, step=2)})  # no objects
+    assert not os.path.exists(os.path.join(path, ".checksums.0"))
+    assert Snapshot(path).verify() == {}  # all-primitive: trivially clean

@@ -367,9 +367,9 @@ class _WritePipeline:
                 await self.storage.write(
                     WriteIO(path=f"{CHECKSUM_FILE_PREFIX}{self.rank}", buf=payload)
                 )
-            elif self.bytes_staged:
-                # This take wrote objects but recorded no checksums
-                # (TORCHSNAPSHOT_TPU_CHECKSUMS=0): remove any stale sidecar a
+            else:
+                # No sidecar written this take (checksums off, or this rank
+                # staged no storage objects): remove any stale sidecar a
                 # previous take left at this path, or verify() would compare
                 # the old digests against the new bytes and report a healthy
                 # snapshot as corrupt.
@@ -377,8 +377,17 @@ class _WritePipeline:
                     await self.storage.delete(
                         f"{CHECKSUM_FILE_PREFIX}{self.rank}"
                     )
+                except (FileNotFoundError, KeyError):
+                    pass  # absent — the common case
                 except Exception:
-                    pass  # absent (the common case) or undeletable
+                    logger.warning(
+                        "Could not delete stale checksum sidecar %s%d; a "
+                        "later verify() of this path may report false "
+                        "corruption",
+                        CHECKSUM_FILE_PREFIX,
+                        self.rank,
+                        exc_info=True,
+                    )
         finally:
             self._shutdown_executor()
         elapsed = time.monotonic() - self.begin_ts

@@ -818,14 +818,22 @@ def _read_checksum_sidecars(
 
     async def read_all() -> None:
         nonlocal found
+        # Capped like every other IO path: a 1024-rank snapshot must not
+        # fire 1024 simultaneous cloud requests (throttling would surface
+        # as silently-skipped sidecars, i.e. spurious 'unverified'/'no
+        # digests' outcomes).
+        from .utils import knobs as _knobs
+
+        sem = asyncio.Semaphore(_knobs.get_max_concurrent_io())
 
         async def read_one(rank: int):
-            read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
-            try:
-                await storage.read(read_io)
-            except Exception:
-                return None
-            return _json.loads(read_io.buf.getvalue().decode())
+            async with sem:
+                read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
+                try:
+                    await storage.read(read_io)
+                except Exception:
+                    return None
+                return _json.loads(read_io.buf.getvalue().decode())
 
         results = await asyncio.gather(*(read_one(r) for r in range(world_size)))
         for r in results:
