@@ -97,7 +97,11 @@ def main() -> None:
 
     from torchsnapshot_tpu import Snapshot, StateDict
 
-    total_gb = float(os.environ.get("BENCH_TOTAL_GB", "2"))
+    # The headline (async stall) is size-independent; the wall-clock cost is
+    # the two background drains over the attached chip's transport, whose
+    # bandwidth varies run to run — 1.25 GB keeps the worst case comfortably
+    # inside driver timeouts while staying >1 GB of real device state.
+    total_gb = float(os.environ.get("BENCH_TOTAL_GB", "1.25"))
     d = jax.devices()[0]
     log(f"device: {d.device_kind} ({d.platform})")
 
@@ -150,7 +154,10 @@ def main() -> None:
         # an array after its first device_get (``jax.Array._npy_value``), so
         # reusing the naive-save slice for the sync take would hand the take
         # a free D2H and inflate its GB/s.
-        n_sub = max(1, len(params) // 8)
+        # Small slices: the naive/sync comparison is throughput-ratio only,
+        # and the attached chip's transport bandwidth drifts minute to
+        # minute — shorter measurements see more consistent conditions.
+        n_sub = max(1, len(params) // 12)
         naive_sub = {k: params[k] for k in list(params)[:n_sub]}
         sync_sub = {k: params[k] for k in list(params)[-n_sub:]}
         if set(naive_sub) & set(sync_sub):  # single-layer model: can't split

@@ -11,14 +11,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu import Snapshot
 from torchsnapshot_tpu.models.moe import (
     MoEConfig,
     ep_spec,
     init_params,
     shard_params_ep,
 )
-from torchsnapshot_tpu.tricks.train_state import Box, PyTreeStateful
+from torchsnapshot_tpu.tricks.train_state import Box, PyTreeStateful, _path_str
 
 
 def _mesh(ep: int, axes=("ep",)) -> Mesh:
@@ -44,7 +44,7 @@ def test_ep_reshard_8_to_2(tmp_path) -> None:
     ep8 = _mesh(8)
     sharded = shard_params_ep(params, ep8)
     flat_before = {
-        "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(v)
+        _path_str(path): np.asarray(v)
         for path, v in jax.tree_util.tree_flatten_with_path(params)[0]
     }
 
@@ -55,7 +55,7 @@ def test_ep_reshard_8_to_2(tmp_path) -> None:
     mesh2 = _mesh(2, axes=("dp", "ep"))
 
     def replace(path_keys, leaf):
-        p = "/".join(str(getattr(k, "key", k)) for k in path_keys)
+        p = _path_str(path_keys)
         return jax.device_put(jnp.zeros_like(leaf), NamedSharding(mesh2, ep_spec(p)))
 
     target = jax.tree_util.tree_map_with_path(replace, params)
@@ -63,7 +63,7 @@ def test_ep_reshard_8_to_2(tmp_path) -> None:
     Snapshot(path).restore({"moe": PyTreeStateful(box)})
 
     flat_after = {
-        "/".join(str(getattr(k, "key", k)) for k in path): np.ascontiguousarray(
+        _path_str(path): np.ascontiguousarray(
             np.asarray(v)
         )
         for path, v in jax.tree_util.tree_flatten_with_path(box.value)[0]
@@ -76,7 +76,9 @@ def test_ep_reshard_8_to_2(tmp_path) -> None:
     # Expert weights really are EP-sharded on the restored target.
     w_up = jax.tree_util.tree_flatten_with_path(box.value)[0]
     ep_leaf = next(
-        v for p, v in w_up if "w_up" in "/".join(str(getattr(k, "key", k)) for k in p)
+        v for p, v in w_up if "w_up" in _path_str(p)
     )
-    assert len({s.device for s in ep_leaf.addressable_shards}) == 8
+    # Genuinely EP-sharded (a replicated leaf would also touch all 8
+    # devices): each shard holds n_experts / ep_degree experts.
+    assert ep_leaf.addressable_shards[0].data.shape[0] == cfg.n_experts // 2
     assert Snapshot(path).verify() == {}

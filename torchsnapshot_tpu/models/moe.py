@@ -24,7 +24,6 @@ GEMM onto the MXU.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,9 +49,11 @@ class MoELayer(nn.Module):
         gate = nn.Dense(cfg.n_experts, use_bias=False, name="gate")(x)
         # Static one-hot dispatch: every token is evaluated against its
         # top-1 expert via einsum over the expert axis (dense compute,
-        # static shapes — the jit/SPMD-friendly formulation).
-        probs = jax.nn.softmax(gate.astype(jnp.float32), axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)
+        # static shapes — the jit/SPMD-friendly formulation). Hard top-1
+        # routing: the gate receives no gradient through this layer (a
+        # checkpoint workload, not a trainable router — softmax-weighted
+        # dispatch would be the trainable variant).
+        top1 = jnp.argmax(gate, axis=-1)
         onehot = jax.nn.one_hot(top1, cfg.n_experts, dtype=x.dtype)
         w_up = self.param(
             "w_up",
@@ -91,9 +92,10 @@ def ep_spec(path: str) -> P:
 def shard_params_ep(params, mesh: Mesh):
     """Place params on ``mesh`` (which must have an ``ep`` axis)."""
 
+    from ..tricks.train_state import _path_str
+
     def place(path, leaf):
-        path_str = "/".join(str(getattr(k, "key", k)) for k in path)
-        spec = ep_spec(path_str)
+        spec = ep_spec(_path_str(path))
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)

@@ -379,15 +379,18 @@ class _WritePipeline:
                     )
                 except (FileNotFoundError, KeyError):
                     pass  # absent — the common case
-                except Exception:
-                    logger.warning(
-                        "Could not delete stale checksum sidecar %s%d; a "
-                        "later verify() of this path may report false "
-                        "corruption",
-                        CHECKSUM_FILE_PREFIX,
-                        self.rank,
-                        exc_info=True,
-                    )
+                except Exception as e:
+                    if type(e).__name__ == "NotFound" or "404" in str(e):
+                        pass  # cloud backends' absent-object errors
+                    else:
+                        logger.warning(
+                            "Could not delete stale checksum sidecar %s%d; "
+                            "a later verify() of this path may report "
+                            "false corruption",
+                            CHECKSUM_FILE_PREFIX,
+                            self.rank,
+                            exc_info=True,
+                        )
         finally:
             self._shutdown_executor()
         elapsed = time.monotonic() - self.begin_ts

@@ -822,9 +822,7 @@ def _read_checksum_sidecars(
         # fire 1024 simultaneous cloud requests (throttling would surface
         # as silently-skipped sidecars, i.e. spurious 'unverified'/'no
         # digests' outcomes).
-        from .utils import knobs as _knobs
-
-        sem = asyncio.Semaphore(_knobs.get_max_concurrent_io())
+        sem = asyncio.Semaphore(knobs.get_max_concurrent_io())
 
         async def read_one(rank: int):
             async with sem:
