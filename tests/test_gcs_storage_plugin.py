@@ -16,6 +16,19 @@ from torchsnapshot_tpu.io_types import ReadIO, WriteIO
 
 
 def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
+    # The fake mirrors the real SDK's error taxonomy: absent blobs raise
+    # google.api_core.exceptions.NotFound (installed below), so the
+    # plugin's absence normalization (NotFound -> FileNotFoundError) is
+    # exercised by every fake-backed test, not just a bespoke one.
+    class FakeNotFound(Exception):
+        pass
+
+    def _lookup(name: str) -> bytes:
+        try:
+            return blobs[name]
+        except KeyError:
+            raise FakeNotFound(f"404 GET {name}") from None
+
     class FakeBlob:
         def __init__(self, name: str) -> None:
             self._name = name
@@ -31,20 +44,21 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
             if n_fail:
                 fail_reads[self._name] = n_fail - 1
                 raise ConnectionError("simulated transient failure")
-            data = blobs[self._name]
+            data = _lookup(self._name)
             if start is None:
                 return data
             return data[start : end + 1]  # GCS ranges are inclusive
 
         def delete(self) -> None:
+            _lookup(self._name)
             del blobs[self._name]
 
         def rewrite(self, src_blob, token=None):
             # One-token resumable rewrite: first call returns a token (as
             # real GCS does for large objects), the second completes.
             if token is None:
-                return ("resume-token", 0, len(blobs[src_blob._name]))
-            blobs[self._name] = blobs[src_blob._name]
+                return ("resume-token", 0, len(_lookup(src_blob._name)))
+            blobs[self._name] = _lookup(src_blob._name)
             FakeBucket.copies.append((src_blob._name, self._name))
             n = len(blobs[self._name])
             return (None, n, n)
@@ -66,11 +80,26 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
     storage_mod.Client = FakeClient
     cloud_mod = types.ModuleType("google.cloud")
     cloud_mod.storage = storage_mod
+    gexc_mod = types.ModuleType("google.api_core.exceptions")
+    gexc_mod.NotFound = FakeNotFound
+    for name in (
+        "TooManyRequests",
+        "InternalServerError",
+        "BadGateway",
+        "ServiceUnavailable",
+        "GatewayTimeout",
+    ):
+        setattr(gexc_mod, name, type(name, (Exception,), {}))
+    api_core_mod = types.ModuleType("google.api_core")
+    api_core_mod.exceptions = gexc_mod
     google_mod = types.ModuleType("google")
     google_mod.cloud = cloud_mod
+    google_mod.api_core = api_core_mod
     monkeypatch.setitem(sys.modules, "google", google_mod)
     monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
     monkeypatch.setitem(sys.modules, "google.cloud.storage", storage_mod)
+    monkeypatch.setitem(sys.modules, "google.api_core", api_core_mod)
+    monkeypatch.setitem(sys.modules, "google.api_core.exceptions", gexc_mod)
 
 
 def _run(coro):
@@ -192,16 +221,24 @@ def test_collective_progress_deadline_expires(fake_gcs) -> None:
     _run(plugin.close())
 
 
-def test_nontransient_error_propagates(fake_gcs) -> None:
+def test_nontransient_error_propagates(fake_gcs, monkeypatch) -> None:
+    """A non-transient, non-absence error is neither retried nor remapped."""
     from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
 
     plugin = GCSStoragePlugin(root="bucket")
+    blob = plugin._bucket.blob("x")
+    monkeypatch.setattr(
+        type(blob),
+        "download_as_bytes",
+        lambda self, start=None, end=None: (_ for _ in ()).throw(
+            PermissionError("403 forbidden")
+        ),
+    )
 
     async def go():
-        rio = ReadIO(path="missing")  # KeyError from the fake: not transient
-        await plugin.read(rio)
+        await plugin.read(ReadIO(path="denied"))
 
-    with pytest.raises(KeyError):
+    with pytest.raises(PermissionError):
         _run(go())
     _run(plugin.close())
 
@@ -286,3 +323,21 @@ def test_incremental_take_uses_server_side_copies(fake_gcs, monkeypatch) -> None
     Snapshot("gs://bucket/s1").restore({"m": out})
     assert np.array_equal(out["head"], np.full((10,), 1, np.float32))
     assert np.array_equal(out["b2"], frozen["b2"])
+
+
+def test_absent_object_normalized_to_file_not_found(fake_gcs) -> None:
+    """GCS NotFound surfaces as FileNotFoundError per the StoragePlugin
+    contract — exercised through the shared fake, whose absent blobs raise
+    the (fake) canonical google.api_core NotFound like the real SDK."""
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    plugin = GCSStoragePlugin(root="bucket")
+
+    async def go():
+        with pytest.raises(FileNotFoundError):
+            await plugin.read(ReadIO(path="missing"))
+        with pytest.raises(FileNotFoundError):
+            await plugin.delete("missing")
+        await plugin.close()
+
+    _run(go())

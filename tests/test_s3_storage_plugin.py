@@ -32,8 +32,19 @@ def _install_fake_aioboto3(monkeypatch, objects: dict) -> None:
                 Body.read() if hasattr(Body, "read") else Body
             )
 
+        @staticmethod
+        def _lookup(Bucket, Key) -> bytes:
+            try:
+                return objects[(Bucket, Key)]
+            except KeyError:
+                # Structured botocore-style error response (what the
+                # plugin's absence normalization reads).
+                e = Exception(f"NoSuchKey: {Key}")
+                e.response = {"Error": {"Code": "NoSuchKey"}}
+                raise e from None
+
         async def get_object(self, Bucket, Key, **kwargs):
-            data = objects[(Bucket, Key)]
+            data = self._lookup(Bucket, Key)
             if "Range" in kwargs:
                 m = re.fullmatch(r"bytes=(\d+)-(\d+)", kwargs["Range"])
                 assert m, f"malformed Range header: {kwargs['Range']}"
@@ -42,7 +53,7 @@ def _install_fake_aioboto3(monkeypatch, objects: dict) -> None:
             return {"Body": FakeStream(data)}
 
         async def delete_object(self, Bucket, Key) -> None:
-            del objects[(Bucket, Key)]
+            objects.pop((Bucket, Key), None)  # S3 deletes are idempotent
 
     class FakeClientCtx:
         async def __aenter__(self):
@@ -163,3 +174,20 @@ def test_live_snapshot_roundtrip() -> None:
     out = {"s": StateDict(arr=np.zeros(1024, dtype=np.float32))}
     Snapshot(path).restore(out)
     assert np.array_equal(out["s"]["arr"], arr)
+
+
+def test_absent_object_normalized_to_file_not_found(fake_s3) -> None:
+    """Per the StoragePlugin contract: read of an absent object raises
+    FileNotFoundError (normalized from S3's structured NoSuchKey); delete is
+    idempotent (S3 returns 204 for absent keys) and succeeds silently."""
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    plugin = S3StoragePlugin(root="bucket")
+
+    async def go():
+        with pytest.raises(FileNotFoundError):
+            await plugin.read(ReadIO(path="missing"))
+        await plugin.delete("missing")  # idempotent: no error
+        await plugin.close()
+
+    _run(go())

@@ -133,3 +133,43 @@ def test_primitive_only_retake_clears_stale_sidecar(tmp_path) -> None:
     Snapshot.take(path, {"s": StateDict(lr=0.1, step=2)})  # no objects
     assert not os.path.exists(os.path.join(path, ".checksums.0"))
     assert Snapshot(path).verify() == {}  # all-primitive: trivially clean
+
+
+def test_verify_distinguishes_unreadable_sidecar(tmp_path) -> None:
+    """A sidecar that exists but can't be parsed (or read past the plugin's
+    retry window) is reported as its own problem class — not conflated with
+    'no checksum recorded' (ADVICE r1: a transient read failure must not
+    masquerade as lost integrity metadata)."""
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, _app())
+    sidecar = os.path.join(path, ".checksums.0")
+    open(sidecar, "w").write("{ not json")
+    problems = Snapshot(path).verify()
+    assert ".checksums.0" in problems
+    assert "sidecar unreadable" in problems[".checksums.0"]
+    # Objects covered only by the unreadable sidecar are flagged with the
+    # unreadable-specific wording, never "no checksum recorded".
+    assert all(
+        "no checksum recorded" not in msg for msg in problems.values()
+    ), problems
+
+
+def test_verify_distinguishes_unreadable_object_from_missing(tmp_path) -> None:
+    """A data object whose read fails with a non-absence error is reported
+    'unreadable', not 'missing' — same transient/gone distinction as for
+    sidecars."""
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, _app())
+    victims = [
+        p
+        for p in glob.glob(os.path.join(path, "**", "*"), recursive=True)
+        if os.path.isfile(p) and not os.path.basename(p).startswith(".")
+    ]
+    victim = sorted(victims)[0]
+    # A directory at the object's path yields IsADirectoryError (non-absence).
+    os.remove(victim)
+    os.makedirs(victim)
+    problems = Snapshot(path).verify()
+    rel = os.path.relpath(victim, path)
+    assert "unreadable" in problems[rel], problems
+    assert problems[rel] != "missing"

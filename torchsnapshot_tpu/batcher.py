@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 import uuid
 from concurrent.futures import Executor
@@ -115,17 +116,21 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
 
         arrs = tuple(req.buffer_stager.arr for req, _, _ in self.members)
         key = _pack_key(arrs)
-        failed_at = _PACK_FAILED.get(key)
+        with _PACK_LOCK:
+            failed_at = _PACK_FAILED.get(key)
+            if failed_at is not None and (
+                time.monotonic() - failed_at >= _PACK_RETRY_COOLDOWN_S
+            ):
+                # Cooldown elapsed: transient causes (a momentary HBM
+                # pressure spike at the to_host resolve) deserve another
+                # chance; a deterministic compile failure will just
+                # re-memoize.
+                _PACK_FAILED.pop(key, None)
+                failed_at = None
         if failed_at is not None:
-            if time.monotonic() - failed_at < _PACK_RETRY_COOLDOWN_S:
-                # This signature failed recently; don't pay a failed
-                # trace/compile plus a full-traceback warning on every take.
-                return await super().stage_buffer(executor)
-            # Cooldown elapsed: transient causes (a momentary HBM pressure
-            # spike at the to_host resolve) deserve another chance; a
-            # deterministic compile failure will just re-memoize. pop, not
-            # del: two concurrently-draining pipelines may race this path.
-            _PACK_FAILED.pop(key, None)
+            # This signature failed recently; don't pay a failed
+            # trace/compile plus a full-traceback warning on every take.
+            return await super().stage_buffer(executor)
         try:
             packed = _pack_to_device_bytes(key, arrs)
             # to_host wraps the async-hint-then-resolve pattern; a device-side
@@ -138,12 +143,13 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
                     f"planned {self.total}"
                 )
         except Exception:
-            if len(_PACK_FAILED) >= _PACK_FAILED_CAP:
-                # Evict oldest (insertion order) rather than refusing the
-                # insert: a refusing cap would defeat the cooldown and
-                # re-warn on every take once full.
-                _PACK_FAILED.pop(next(iter(_PACK_FAILED)), None)
-            _PACK_FAILED[key] = time.monotonic()
+            with _PACK_LOCK:
+                if len(_PACK_FAILED) >= _PACK_FAILED_CAP:
+                    # Evict oldest (insertion order) rather than refusing
+                    # the insert: a refusing cap would defeat the cooldown
+                    # and re-warn on every take once full.
+                    _PACK_FAILED.pop(next(iter(_PACK_FAILED)), None)
+                _PACK_FAILED[key] = time.monotonic()
             logger.warning(
                 "On-device slab packing failed; falling back to host-side "
                 "packing for %d members (device path for this slab "
@@ -242,7 +248,12 @@ def _pack_to_device_bytes(key, arrs):
 
         return jax.jit(pack)
 
-    return _PACK_FNS.get_or_build(key, build)(arrs)
+    # Lock held across build(): it only constructs the jit wrapper (no
+    # trace/compile — that happens at the call below, outside the lock), and
+    # admitting concurrent builders would double-compile the pack fn.
+    with _PACK_LOCK:
+        fn = _PACK_FNS.get_or_build(key, build)
+    return fn(arrs)
 
 
 # One key per slab (not per state structure): a checkpoint with N small-param
@@ -251,6 +262,11 @@ def _pack_to_device_bytes(key, arrs):
 # slabs ≈ 32 GB of small params. A sequential scan over more keys than
 # capacity is the LRU worst case (0% hits, full recompile every take).
 _PACK_FNS = BoundedLRU(capacity=256)
+
+# Guards _PACK_FNS and _PACK_FAILED: a sync take's loop thread and an async
+# take's background drain can run these pipelines concurrently, and neither
+# BoundedLRU nor the dict's check-then-mutate sequences are atomic.
+_PACK_LOCK = threading.Lock()
 
 # key -> monotonic time of last device-path failure. Failed signatures skip
 # straight to host packing until the cooldown elapses (transient causes like

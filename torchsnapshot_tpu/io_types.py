@@ -102,6 +102,14 @@ class StoragePlugin(abc.ABC):
     Implementations must be safe for many concurrent in-flight operations on
     one event loop. Ranged reads (``ReadIO.byte_range``) enable random access
     into cloud-resident snapshots without fetching whole objects.
+
+    **Absence contract**: ``read`` of an object that does not exist raises
+    :class:`FileNotFoundError` — each plugin normalizes its backend's absence
+    error (ENOENT, GCS ``NotFound``, S3 ``NoSuchKey``) so callers never sniff
+    backend-specific exception names or messages. ``delete`` of an absent
+    object either succeeds silently (idempotent backends like S3) or raises
+    :class:`FileNotFoundError`; it never raises a backend-specific absence
+    error.
     """
 
     @abc.abstractmethod

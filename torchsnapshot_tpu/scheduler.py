@@ -377,20 +377,21 @@ class _WritePipeline:
                     await self.storage.delete(
                         f"{CHECKSUM_FILE_PREFIX}{self.rank}"
                     )
-                except (FileNotFoundError, KeyError):
-                    pass  # absent — the common case
-                except Exception as e:
-                    if type(e).__name__ == "NotFound" or "404" in str(e):
-                        pass  # cloud backends' absent-object errors
-                    else:
-                        logger.warning(
-                            "Could not delete stale checksum sidecar %s%d; "
-                            "a later verify() of this path may report "
-                            "false corruption",
-                            CHECKSUM_FILE_PREFIX,
-                            self.rank,
-                            exc_info=True,
-                        )
+                except FileNotFoundError:
+                    # Absent — the common case. Plugins normalize their
+                    # backend's absence error to FileNotFoundError (the
+                    # StoragePlugin contract), so no name/message sniffing
+                    # is needed here.
+                    pass
+                except Exception:
+                    logger.warning(
+                        "Could not delete stale checksum sidecar %s%d; "
+                        "a later verify() of this path may report "
+                        "false corruption",
+                        CHECKSUM_FILE_PREFIX,
+                        self.rank,
+                        exc_info=True,
+                    )
         finally:
             self._shutdown_executor()
         elapsed = time.monotonic() - self.begin_ts

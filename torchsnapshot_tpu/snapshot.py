@@ -70,6 +70,7 @@ from .parallel.store import LinearBarrier
 from .partitioner import partition_write_reqs
 from .rng_state import RNGState
 from .scheduler import (
+    CHECKSUM_FILE_PREFIX,
     PendingIOWork,
     get_process_memory_budget_bytes,
     sync_execute_read_reqs,
@@ -377,9 +378,18 @@ class Snapshot:
                     base,
                 )
                 return None
-            merged, _ = _read_checksum_sidecars(
+            merged, _, unreadable = _read_checksum_sidecars(
                 storage, metadata.world_size, event_loop
             )
+            if unreadable:
+                # Degraded dedup is acceptable (missing digests just mean
+                # full writes for those objects) but must be visible.
+                logger.warning(
+                    "base=%s: checksum sidecars unreadable (%s); objects "
+                    "recorded only there will be fully rewritten",
+                    base,
+                    unreadable,
+                )
             # Skip sha-less entries (dedup digests were off): an all-None
             # base then hits the no-digests warning below instead of
             # loading as a silently useless base.
@@ -575,11 +585,11 @@ class Snapshot:
             metadata = self._read_metadata(storage, event_loop)
             # Can't tell "rank wrote no objects" from "sidecar lost"; the
             # manifest cross-check below reports uncovered objects either way.
-            expected, sidecars = _read_checksum_sidecars(
+            expected, sidecars, unreadable = _read_checksum_sidecars(
                 storage, metadata.world_size, event_loop
             )
             manifest_locations = _manifest_storage_locations(metadata.manifest)
-            if not sidecars:
+            if not sidecars and not unreadable:
                 if not manifest_locations:
                     # All-primitive snapshot: no storage objects were ever
                     # written, so there is nothing to audit — trivially clean.
@@ -589,12 +599,20 @@ class Snapshot:
                     "TORCHSNAPSHOT_TPU_CHECKSUMS=0?); nothing to verify"
                 )
             problems: Dict[str, str] = {}
+            # A sidecar that exists but can't be read/parsed is its own
+            # problem class: the integrity metadata may be intact on the
+            # backend (transient throttling), so don't misreport its
+            # objects as 'unverified (no checksum recorded)'.
+            for r, err in sorted(unreadable.items()):
+                problems[f"{CHECKSUM_FILE_PREFIX}{r}"] = (
+                    f"sidecar unreadable ({err})"
+                )
             # Coverage cross-check: every storage object the manifest points
             # at must carry a recorded checksum, else a lost sidecar would
             # yield a false "clean".
             for location in sorted(manifest_locations):
                 if location not in expected:
-                    problems[location] = "unverified (no checksum recorded)"
+                    problems[location] = _uncovered_problem(location, unreadable)
 
             async def check_all() -> None:
                 # Created on the running loop. Concurrency is capped by the
@@ -621,8 +639,14 @@ class Snapshot:
                             read_io = ReadIO(path=path)
                             try:
                                 await storage.read(read_io)
-                            except Exception:
+                            except FileNotFoundError:
                                 problems[path] = "missing"
+                                return
+                            except Exception as e:  # noqa: BLE001
+                                # Same distinction as for sidecars: a read
+                                # failing past the plugin's retry window is
+                                # not evidence the object is gone.
+                                problems[path] = f"unreadable ({e!r})"
                                 return
                             got = _zlib.crc32(read_io.buf.getbuffer())
                             # Sidecar value: bare crc int (pre-digest
@@ -801,20 +825,24 @@ def _read_checksum_sidecars(
     storage: StoragePlugin,
     world_size: int,
     event_loop: asyncio.AbstractEventLoop,
-) -> Tuple[Dict[str, Any], int]:
+) -> Tuple[Dict[str, Any], int, Dict[int, str]]:
     """Read + merge every rank's ``.checksums.<rank>`` sidecar concurrently.
 
-    Returns (merged {storage_path: digest}, number of sidecars found).
-    Unreadable sidecars are skipped — callers decide what absence means.
+    Returns (merged {storage_path: digest}, number of sidecars found,
+    {rank: error} for sidecars that exist-or-may-exist but could not be
+    read). Absence (``FileNotFoundError``, per the StoragePlugin contract)
+    is expected — a rank that staged no storage objects writes no sidecar;
+    any *other* failure (cloud throttling past the plugin's retry window, a
+    corrupt JSON body) is reported separately so callers never mistake a
+    transient read failure for lost integrity metadata.
     The single source of truth for sidecar parsing: ``verify()`` and the
     incremental-base loader must never diverge on the format.
     """
     import json as _json
 
-    from .scheduler import CHECKSUM_FILE_PREFIX
-
     merged: Dict[str, Any] = {}
     found = 0
+    unreadable: Dict[int, str] = {}
 
     async def read_all() -> None:
         nonlocal found
@@ -829,9 +857,16 @@ def _read_checksum_sidecars(
                 read_io = ReadIO(path=f"{CHECKSUM_FILE_PREFIX}{rank}")
                 try:
                     await storage.read(read_io)
-                except Exception:
+                except FileNotFoundError:
+                    return None  # absent — the rank wrote no objects
+                except Exception as e:  # noqa: BLE001 - reported, not dropped
+                    unreadable[rank] = repr(e)
                     return None
-                return _json.loads(read_io.buf.getvalue().decode())
+                try:
+                    return _json.loads(read_io.buf.getvalue().decode())
+                except Exception as e:  # noqa: BLE001 - corrupt sidecar body
+                    unreadable[rank] = f"unparseable: {e!r}"
+                    return None
 
         results = await asyncio.gather(*(read_one(r) for r in range(world_size)))
         for r in results:
@@ -840,7 +875,31 @@ def _read_checksum_sidecars(
                 merged.update(r)
 
     event_loop.run_until_complete(read_all())
-    return merged, found
+    return merged, found, unreadable
+
+
+def _uncovered_problem(location: str, unreadable: Dict[int, str]) -> str:
+    """Problem text for a manifest object no readable sidecar covers.
+
+    Attribution matters operationally: 'unreadable' suggests a transient
+    backend failure (retry verify), while 'no checksum recorded' means the
+    integrity metadata is genuinely gone. Per-rank locations (``<rank>/...``)
+    attribute precisely via their path prefix; ``sharded/``/``replicated/``/
+    ``batched/`` objects may have been written by any rank, so when some
+    sidecar was unreadable the report stays hedged rather than wrongly
+    asserting the metadata never existed."""
+    owner, _, _ = location.partition("/")
+    if owner.isdigit():
+        if int(owner) in unreadable:
+            return "unverified (this rank's checksum sidecar was unreadable)"
+        return "unverified (no checksum recorded)"
+    if unreadable:
+        ranks = ",".join(str(r) for r in sorted(unreadable))
+        return (
+            "unverified (uncovered by any readable sidecar; the sidecar of "
+            f"rank(s) {ranks} was unreadable and may cover this object)"
+        )
+    return "unverified (no checksum recorded)"
 
 
 def _manifest_storage_locations(manifest: Manifest) -> Set[str]:

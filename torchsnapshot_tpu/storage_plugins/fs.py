@@ -108,9 +108,12 @@ class FSStoragePlugin(StoragePlugin):
         bytes instead. Hard links share the inode, so deleting the base
         snapshot later does NOT invalidate this one."""
         dst = os.path.join(self.root, path)
-        self._ensure_parent(dst)
         tmp = f"{dst}.tmp.{uuid.uuid4().hex[:8]}"
         try:
+            # Inside the try: a mkdir failure (permissions, race) must also
+            # fail soft — link_in's contract is False-then-fallback, never
+            # aborting the take.
+            self._ensure_parent(dst)
             os.link(src_abs_path, tmp)
             os.replace(tmp, dst)
             return True

@@ -114,19 +114,29 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         blob = self._bucket.blob(self._blob_path(read_io.path))
-        if read_io.byte_range is None:
-            data = await self._retrying(blob.download_as_bytes)
-        else:
-            begin, end = read_io.byte_range
-            data = await self._retrying(
-                # GCS ranges are inclusive on both ends.
-                lambda: blob.download_as_bytes(start=begin, end=end - 1)
-            )
+        try:
+            if read_io.byte_range is None:
+                data = await self._retrying(blob.download_as_bytes)
+            else:
+                begin, end = read_io.byte_range
+                data = await self._retrying(
+                    # GCS ranges are inclusive on both ends.
+                    lambda: blob.download_as_bytes(start=begin, end=end - 1)
+                )
+        except Exception as e:
+            if _is_not_found(e):
+                raise FileNotFoundError(read_io.path) from e
+            raise
         read_io.buf.write(data)
 
     async def delete(self, path: str) -> None:
         blob = self._bucket.blob(self._blob_path(path))
-        await self._retrying(blob.delete)
+        try:
+            await self._retrying(blob.delete)
+        except Exception as e:
+            if _is_not_found(e):
+                raise FileNotFoundError(path) from e
+            raise
 
     async def link_in(self, src_abs_path: str, path: str) -> bool:
         """Server-side copy from a base snapshot (incremental takes): a GCS
@@ -162,6 +172,16 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         self._executor.shutdown(wait=True)
+
+
+def _is_not_found(e: Exception) -> bool:
+    """Backend absence, normalized per the StoragePlugin contract."""
+    try:
+        from google.api_core import exceptions as gexc  # type: ignore[import-not-found]
+
+        return isinstance(e, gexc.NotFound)
+    except ImportError:
+        return False
 
 
 def _is_transient(e: Exception) -> bool:

@@ -26,11 +26,17 @@ class MemoryStoragePlugin(StoragePlugin):
         self.objects[write_io.path] = bytes(write_io.buf)
 
     async def read(self, read_io: ReadIO) -> None:
-        data = self.objects[read_io.path]
+        try:
+            data = self.objects[read_io.path]
+        except KeyError:
+            raise FileNotFoundError(read_io.path) from None
         if read_io.byte_range is not None:
             begin, end = read_io.byte_range
             data = data[begin:end]
         read_io.buf.write(data)
 
     async def delete(self, path: str) -> None:
-        del self.objects[path]
+        try:
+            del self.objects[path]
+        except KeyError:
+            raise FileNotFoundError(path) from None

@@ -58,13 +58,22 @@ class S3StoragePlugin(StoragePlugin):
             begin, end = read_io.byte_range
             # HTTP Range end is inclusive.
             kwargs["Range"] = f"bytes={begin}-{end - 1}"
-        resp = await client.get_object(
-            Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
-        )
+        try:
+            resp = await client.get_object(
+                Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
+            )
+        except Exception as e:
+            if _is_no_such_key(e):
+                raise FileNotFoundError(read_io.path) from e
+            raise
         async with resp["Body"] as stream:
             read_io.buf.write(await stream.read())
 
     async def delete(self, path: str) -> None:
+        # S3 DeleteObject is idempotent (204 for absent keys) — the allowed
+        # "succeeds silently on absence" form of the StoragePlugin delete
+        # contract. No HEAD probe: it would double round-trips and break
+        # under delete-only IAM policies (HeadObject needs read permission).
         client = await self._get_client()
         await client.delete_object(Bucket=self.bucket, Key=self._key(path))
 
@@ -101,3 +110,13 @@ class S3StoragePlugin(StoragePlugin):
             await self._client_ctx.__aexit__(None, None, None)
             self._client = None
             self._client_ctx = None
+
+
+def _is_no_such_key(e: Exception) -> bool:
+    """Backend absence, normalized per the StoragePlugin contract. Reads the
+    structured botocore error code, not exception names/messages."""
+    code = getattr(e, "response", None)
+    if isinstance(code, dict):
+        code = code.get("Error", {}).get("Code")
+        return code in ("NoSuchKey", "NotFound", "404")
+    return False
