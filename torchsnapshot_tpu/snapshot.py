@@ -564,12 +564,18 @@ class Snapshot:
         pre-commit, so every committed snapshot taken with
         ``TORCHSNAPSHOT_TPU_CHECKSUMS=1`` — the default — carries them).
 
-        Returns a ``{storage_path: problem}`` dict: ``"missing"`` for
-        objects that can't be read, ``"crc mismatch (...)"`` for corrupted
-        bytes. Empty dict == clean. Raises ``RuntimeError`` if the manifest
-        references storage objects but no checksum sidecar exists (taken
-        with checksums disabled); a snapshot of only inline primitives has
-        no objects to audit and returns clean.
+        Returns a ``{storage_path: problem}`` dict. Problem classes:
+        ``"missing"`` (the object is absent — ``FileNotFoundError`` per the
+        StoragePlugin contract), ``"crc mismatch (...)"`` (corrupted bytes),
+        ``"unreadable (...)"`` (the read failed for a non-absence reason,
+        e.g. throttling past the plugin's retry window — possibly
+        transient), ``"sidecar unreadable (...)"`` (a ``.checksums.<rank>``
+        file exists but can't be read/parsed), and ``"unverified (...)"``
+        (a manifest object no readable sidecar covers). Empty dict ==
+        clean. Raises ``RuntimeError`` if the manifest references storage
+        objects but no checksum sidecar exists at all (taken with checksums
+        disabled); a snapshot of only inline primitives has no objects to
+        audit and returns clean.
 
         Beyond the reference's capability surface: it has no integrity
         audit; this one enables post-transfer/post-incident validation
@@ -863,10 +869,19 @@ def _read_checksum_sidecars(
                     unreadable[rank] = repr(e)
                     return None
                 try:
-                    return _json.loads(read_io.buf.getvalue().decode())
+                    parsed = _json.loads(read_io.buf.getvalue().decode())
                 except Exception as e:  # noqa: BLE001 - corrupt sidecar body
                     unreadable[rank] = f"unparseable: {e!r}"
                     return None
+                if not isinstance(parsed, dict):
+                    # Valid JSON but not a digest map (truncation artifacts
+                    # like 'null' or '[]'): corruption, not absence.
+                    unreadable[rank] = (
+                        f"unparseable: expected a JSON object, got "
+                        f"{type(parsed).__name__}"
+                    )
+                    return None
+                return parsed
 
         results = await asyncio.gather(*(read_one(r) for r in range(world_size)))
         for r in results:

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import queue as queue_mod
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
@@ -141,7 +143,13 @@ def _worker_entry(
                 store = _coord_mod._CACHED.store
                 store.add("__launcher_exit__", 1)
                 if rank == 0:
-                    deadline = _time.monotonic() + 20
+                    # Bounded linger; tests that kill peers outright can
+                    # shrink it so the survivor doesn't idle out the full
+                    # default waiting for a checkout that will never come.
+                    drain_s = float(
+                        os.environ.get("TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S", "20")
+                    )
+                    deadline = _time.monotonic() + drain_s
                     while _time.monotonic() < deadline:
                         if store.add("__launcher_exit__", 0) >= world_size:
                             break
@@ -190,13 +198,43 @@ def run_with_processes(
         p.start()
         procs.append(p)
     failures: Dict[int, str] = {}
-    done = 0
+    reported: set = set()
+    # A worker killed outright (SIGKILL — the preemption failure mode) never
+    # reports; treat "process dead + nothing queued" as its report. The
+    # two-consecutive-observations grace covers the race where a worker's
+    # queue item is still in flight when the process exits.
+    dead_strikes: Dict[int, int] = {}
+    deadline = time.monotonic() + timeout_s
     try:
-        while done < nproc:
-            rank, err = error_queue.get(timeout=timeout_s)
-            done += 1
+        while len(reported) < nproc:
+            try:
+                rank, err = error_queue.get(timeout=0.2)
+            except queue_mod.Empty:
+                for r, p in enumerate(procs):
+                    if r in reported or p.is_alive():
+                        continue
+                    dead_strikes[r] = dead_strikes.get(r, 0) + 1
+                    if dead_strikes[r] >= 2:
+                        reported.add(r)
+                        failures[r] = (
+                            f"died without reporting (exitcode {p.exitcode})"
+                        )
+                if time.monotonic() > deadline:
+                    pending = sorted(set(range(nproc)) - reported)
+                    raise TimeoutError(
+                        f"ranks {pending} neither reported nor exited within "
+                        f"{timeout_s}s"
+                    )
+                continue
+            reported.add(rank)
+            # A queue item proves feeder threads are still flushing: restart
+            # every not-yet-reported rank's death grace, and clear a false
+            # death verdict if this rank's real report just arrived late.
+            dead_strikes.clear()
             if err is not None:
                 failures[rank] = err
+            else:
+                failures.pop(rank, None)
     finally:
         for p in procs:
             p.join(timeout=30)
