@@ -113,6 +113,40 @@ def overlap(
     return tuple(src_slices), tuple(dst_slices)
 
 
+def _budgeted_pieces(
+    shard: Shard, buffer_size_limit_bytes: Optional[int]
+) -> List[Tuple[List[int], List[int], Optional[Tuple[int, int]]]]:
+    """Split one saved shard into budget-sized row groups along dim 0.
+
+    Returns ``(offsets, sizes, byte_range)`` triples in *global* coordinates;
+    ``byte_range`` is relative to the start of the shard's serialized bytes
+    (``None`` means read the whole shard — no split needed or possible).
+    Shards are saved C-contiguous, so a run of whole dim-0 rows is exactly one
+    contiguous byte range. A single row wider than the budget is admitted
+    whole — the same one-over-budget escape hatch the scheduler uses.
+    """
+    from ..serialization import string_to_dtype
+
+    entry = shard.tensor
+    if entry.serializer != Serializer.RAW or not shard.sizes:
+        return [(shard.offsets, shard.sizes, None)]
+    itemsize = string_to_dtype(entry.dtype).itemsize
+    nbytes = int(np.prod(shard.sizes)) * itemsize
+    if buffer_size_limit_bytes is None or nbytes <= buffer_size_limit_bytes:
+        return [(shard.offsets, shard.sizes, None)]
+    row_bytes = int(np.prod(shard.sizes[1:])) * itemsize if len(shard.sizes) > 1 else itemsize
+    rows_per_read = max(1, buffer_size_limit_bytes // max(row_bytes, 1))
+    pieces: List[Tuple[List[int], List[int], Optional[Tuple[int, int]]]] = []
+    for r0 in range(0, shard.sizes[0], rows_per_read):
+        r1 = min(r0 + rows_per_read, shard.sizes[0])
+        off = list(shard.offsets)
+        sz = list(shard.sizes)
+        off[0] = shard.offsets[0] + r0
+        sz[0] = r1 - r0
+        pieces.append((off, sz, (r0 * row_bytes, r1 * row_bytes)))
+    return pieces
+
+
 class ShardedArrayBufferConsumer(BufferConsumer):
     """Deserializes one saved shard and scatters it into every overlapping
     destination buffer (reference ``ShardedTensorBufferConsumer:288``)."""
@@ -198,32 +232,64 @@ class ShardedArrayIOPreparer:
 
     @staticmethod
     def prepare_read(
-        entry: ShardedArrayEntry, targets: List[TargetShard]
+        entry: ShardedArrayEntry,
+        targets: List[TargetShard],
+        buffer_size_limit_bytes: Optional[int] = None,
     ) -> List[ReadReq]:
         """Plan reads scattering saved shards into ``targets``.
 
         Each saved shard overlapping at least one target is read exactly once
-        per process; non-overlapping saved shards are never fetched.
+        per process; non-overlapping saved shards are never fetched. With
+        ``buffer_size_limit_bytes``, raw-serialized shards larger than the
+        budget are fetched as row-aligned byte-range sub-reads (the sharded
+        analogue of ``ArrayIOPreparer.prepare_read``'s budget chunking,
+        reference ``io_preparers/tensor.py:120-166``) so ``read_object`` on an
+        operator VM never holds more than ~budget bytes of any one shard.
         """
         read_reqs: List[ReadReq] = []
         for shard in entry.shards:
-            copy_specs = []
-            for dst, dst_off, dst_sz in targets:
-                ov = overlap(shard.offsets, shard.sizes, dst_off, dst_sz)
-                if ov is not None:
-                    src_slices, dst_slices = ov
-                    copy_specs.append((dst, src_slices, dst_slices))
-            if not copy_specs:
+            if not any(
+                overlap(shard.offsets, shard.sizes, dst_off, dst_sz)
+                for _, dst_off, dst_sz in targets
+            ):
                 continue
-            read_reqs.append(
-                ReadReq(
-                    path=shard.tensor.location,
-                    buffer_consumer=ShardedArrayBufferConsumer(shard.tensor, copy_specs),
-                    byte_range=tuple(shard.tensor.byte_range)
-                    if shard.tensor.byte_range
-                    else None,
+            base = tuple(shard.tensor.byte_range) if shard.tensor.byte_range else None
+            for sub_off, sub_sz, byte_range in _budgeted_pieces(
+                shard, buffer_size_limit_bytes
+            ):
+                copy_specs = []
+                for dst, dst_off, dst_sz in targets:
+                    ov = overlap(sub_off, sub_sz, dst_off, dst_sz)
+                    if ov is not None:
+                        src_slices, dst_slices = ov
+                        copy_specs.append((dst, src_slices, dst_slices))
+                if not copy_specs:
+                    continue
+                sub_entry = (
+                    shard.tensor
+                    if byte_range is None
+                    else ArrayEntry(
+                        location=shard.tensor.location,
+                        serializer=shard.tensor.serializer,
+                        dtype=shard.tensor.dtype,
+                        shape=list(sub_sz),
+                        replicated=shard.tensor.replicated,
+                    )
                 )
-            )
+                if byte_range is None:
+                    final_range = base
+                else:
+                    offset = base[0] if base else 0
+                    final_range = (offset + byte_range[0], offset + byte_range[1])
+                read_reqs.append(
+                    ReadReq(
+                        path=shard.tensor.location,
+                        buffer_consumer=ShardedArrayBufferConsumer(
+                            sub_entry, copy_specs
+                        ),
+                        byte_range=final_range,
+                    )
+                )
         return read_reqs
 
 

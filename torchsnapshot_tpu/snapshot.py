@@ -1006,7 +1006,9 @@ def _prepare_restore_one(
             sharding = live.sharding
             buffers = alloc_target_shards(sharding, entry.shape, np_dtype)
             targets = [(buf, off, sz) for buf, off, sz in buffers.values()]
-            reqs = ShardedArrayIOPreparer.prepare_read(entry, targets)
+            reqs = ShardedArrayIOPreparer.prepare_read(
+                entry, targets, buffer_size_limit_bytes
+            )
 
             def finalize_sharded() -> None:
                 loaded[logical_path] = assemble_jax_array(
@@ -1024,7 +1026,9 @@ def _prepare_restore_one(
         )
         target = live if in_place else np.empty(tuple(entry.shape), dtype=np_dtype)
         reqs = ShardedArrayIOPreparer.prepare_read(
-            entry, [(target, [0] * len(entry.shape), list(entry.shape))]
+            entry,
+            [(target, [0] * len(entry.shape), list(entry.shape))],
+            buffer_size_limit_bytes,
         )
         loaded[logical_path] = target
         return reqs, None
