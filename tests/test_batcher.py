@@ -232,3 +232,34 @@ def test_device_pack_failure_memoized(tmp_path, caplog, monkeypatch) -> None:
     Snapshot(str(tmp_path / "b")).restore({"s": out})
     for k, want in expected.items():
         assert np.array_equal(np.asarray(out[k]), want), k
+
+
+def test_read_merge_respects_budget_cap() -> None:
+    """batch_read_requests must not coalesce budget-capped sub-reads back
+    into the whole-object read they were split to avoid."""
+    from torchsnapshot_tpu.batcher import batch_read_requests
+    from torchsnapshot_tpu.io_types import BufferConsumer, ReadReq
+
+    class _Noop(BufferConsumer):
+        async def consume_buffer(self, buf, executor=None):
+            pass
+
+        def get_consuming_cost_bytes(self):
+            return 0
+
+    reqs = [
+        ReadReq(path="obj", buffer_consumer=_Noop(), byte_range=(i * 100, (i + 1) * 100))
+        for i in range(8)
+    ]
+    merged = batch_read_requests(list(reqs), max_merged_bytes=250)
+    assert all(r.byte_range[1] - r.byte_range[0] <= 250 for r in merged)
+    # Full coverage preserved, in order.
+    spans = sorted(r.byte_range for r in merged)
+    assert spans[0][0] == 0 and spans[-1][1] == 800
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
+    # Uncapped: one merged read.
+    assert len(batch_read_requests(list(reqs))) == 1
+    # A single over-cap request still passes through whole.
+    big = [ReadReq(path="obj", buffer_consumer=_Noop(), byte_range=(0, 1000))]
+    assert batch_read_requests(list(big), max_merged_bytes=250)[0].byte_range == (0, 1000)

@@ -341,3 +341,217 @@ def test_absent_object_normalized_to_file_not_found(fake_gcs) -> None:
         await plugin.close()
 
     _run(go())
+
+
+class _FakeResumableSession:
+    """Simulates a GCS resumable-upload session with the real library's
+    cursor semantics: a faulted transmit NEVER advances ``bytes_uploaded``
+    (google-resumable-media only updates it on success or in ``recover()``);
+    the server's partial persistence of the interrupted chunk (here: half,
+    256-byte aligned) becomes visible only after ``recover()``. ``faults``
+    maps transmit ordinals (0-based) to the exception to raise."""
+
+    def __init__(self, blobs, blob_name, mv, chunk_bytes, faults, stats):
+        self._blobs = blobs
+        self._name = blob_name
+        self._mv = memoryview(mv)
+        self._chunk = chunk_bytes
+        self._faults = faults
+        self._stats = stats
+        self._cursor = 0  # client-visible bytes_uploaded
+        self._server_persisted = 0  # revealed by recover()
+        self._invalid = False
+        self._transmits = 0
+
+    @property
+    def finished(self):
+        return self._cursor >= self._mv.nbytes
+
+    @property
+    def bytes_uploaded(self):
+        return self._cursor
+
+    def transmit_next_chunk(self):
+        if self._invalid:
+            raise AssertionError("transmit before recover() on invalid session")
+        ordinal = self._transmits
+        self._transmits += 1
+        end = min(self._cursor + self._chunk, self._mv.nbytes)
+        sent = end - self._cursor
+        self._stats["sent"] += sent
+        if ordinal in self._faults:
+            # Server kept an aligned prefix of the interrupted chunk, but
+            # the client cursor stays stale until recover().
+            kept = (sent // 2) // 256 * 256
+            self._server_persisted = self._cursor + kept
+            self._invalid = True
+            raise self._faults.pop(ordinal)
+        self._cursor = end
+        self._server_persisted = end
+        if self.finished:
+            self._blobs[self._name] = bytes(self._mv)
+
+    def recover(self):
+        self._stats["recovers"] += 1
+        self._cursor = self._server_persisted
+        self._invalid = False
+
+
+def test_resumable_upload_recovers_cursor_mid_chunk(fake_gcs, monkeypatch) -> None:
+    """A multi-chunk upload hit by transient mid-chunk faults completes with
+    at most one chunk re-sent per fault (reference ``gcs.py:110-122``)."""
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    blobs, _ = fake_gcs
+    payload = bytes(range(256)) * 40  # 10 KiB
+    chunk = 1024
+    faults = {
+        1: ConnectionError("reset mid-chunk"),
+        4: TimeoutError("stalled"),
+        7: ConnectionError("reset again"),
+    }
+    n_faults = len(faults)
+    stats = {"sent": 0, "recovers": 0}
+
+    def fake_factory(client, bucket_name, blob_name, mv, chunk_bytes, transport_factory=None):
+        assert chunk_bytes == chunk
+        return _FakeResumableSession(blobs, blob_name, mv, chunk_bytes, faults, stats)
+
+    monkeypatch.setattr(gcs_mod, "_make_resumable_session", fake_factory)
+    plugin = GCSStoragePlugin(root="bucket")
+
+    with knobs.override_gcs_chunk_bytes(chunk):
+        _run(plugin.write(WriteIO(path="big", buf=payload)))
+    _run(plugin.close())
+
+    assert blobs["big"] == payload
+    assert stats["recovers"] == n_faults
+    # <= one chunk re-sent per fault; with half-chunk server persistence the
+    # overshoot is strictly below n_faults full chunks.
+    assert stats["sent"] - len(payload) <= n_faults * chunk
+    assert stats["sent"] - len(payload) > 0  # faults really did cost re-sends
+
+
+def test_small_objects_keep_one_shot_upload(fake_gcs, monkeypatch) -> None:
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    blobs, _ = fake_gcs
+
+    def exploding_factory(*a, **k):
+        raise AssertionError("resumable session created for a small object")
+
+    monkeypatch.setattr(gcs_mod, "_make_resumable_session", exploding_factory)
+    plugin = GCSStoragePlugin(root="bucket")
+    _run(plugin.write(WriteIO(path="small", buf=b"tiny")))
+    _run(plugin.close())
+    assert blobs["small"] == b"tiny"
+
+
+def test_resumable_upload_nontransient_fault_propagates(fake_gcs, monkeypatch) -> None:
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    blobs, _ = fake_gcs
+    payload = bytes(512)
+    stats = {"sent": 0, "recovers": 0}
+    faults = {0: PermissionError("403")}
+
+    def fake_factory(client, bucket_name, blob_name, mv, chunk_bytes, transport_factory=None):
+        return _FakeResumableSession(blobs, blob_name, mv, chunk_bytes, faults, stats)
+
+    monkeypatch.setattr(gcs_mod, "_make_resumable_session", fake_factory)
+    plugin = GCSStoragePlugin(root="bucket")
+    with knobs.override_gcs_chunk_bytes(256):
+        with pytest.raises(PermissionError):
+            _run(plugin.write(WriteIO(path="denied", buf=payload)))
+    _run(plugin.close())
+    assert "denied" not in blobs
+    assert stats["recovers"] == 0
+
+
+def test_resumable_upload_stalled_chunk_aborts(fake_gcs, monkeypatch) -> None:
+    """A chunk that transiently fails forever (while recover() keeps
+    succeeding) must abort after the stalled-chunk cap, not retry
+    indefinitely — successful recovers refresh the collective-progress
+    window, so the window alone can never expire this loop."""
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    blobs, _ = fake_gcs
+    payload = bytes(4096)
+    stats = {"sent": 0, "recovers": 0}
+
+    class _AlwaysFailingSession(_FakeResumableSession):
+        def transmit_next_chunk(self):
+            self._stats["sent"] += 0
+            self._invalid = True
+            raise ConnectionError("black-holed chunk")
+
+    def fake_factory(client, bucket_name, blob_name, mv, chunk_bytes, transport_factory=None):
+        return _AlwaysFailingSession(blobs, blob_name, mv, chunk_bytes, {}, stats)
+
+    monkeypatch.setattr(gcs_mod, "_make_resumable_session", fake_factory)
+    monkeypatch.setattr(gcs_mod, "_MAX_STALLED_CHUNK_RETRIES", 3)
+    plugin = GCSStoragePlugin(root="bucket")
+    with knobs.override_gcs_chunk_bytes(1024):
+        with pytest.raises(ConnectionError):
+            _run(plugin.write(WriteIO(path="stuck", buf=payload)))
+    _run(plugin.close())
+    assert "stuck" not in blobs
+    # One recovery per stalled attempt: the counter is judged on the
+    # recovered cursor, so the cap fires after the third recover shows
+    # no progress.
+    assert stats["recovers"] == 3
+
+
+def test_resumable_upload_lost_final_ack_treated_as_committed(
+    fake_gcs, monkeypatch
+) -> None:
+    """If the connection drops after GCS persists the final chunk but before
+    the 200 ack arrives, the status probe of the completed session returns
+    200 (not 308) and resumable_media surfaces it as an error; the plugin
+    must recognize the upload as committed instead of failing the take."""
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    blobs, _ = fake_gcs
+    payload = bytes(range(256)) * 8  # 2 KiB: 2 chunks of 1024
+    stats = {"sent": 0, "recovers": 0}
+
+    class _Completed200(Exception):
+        def __init__(self):
+            self.response = types.SimpleNamespace(status_code=200)
+
+    class _LostAckSession(_FakeResumableSession):
+        def transmit_next_chunk(self):
+            end = min(self._cursor + self._chunk, self._mv.nbytes)
+            self._stats["sent"] += end - self._cursor
+            if end >= self._mv.nbytes:
+                # Server commits the object; only the ack is lost.
+                self._server_persisted = self._mv.nbytes
+                self._blobs[self._name] = bytes(self._mv)
+                self._invalid = True
+                raise ConnectionError("final ack lost")
+            self._cursor = end
+            self._server_persisted = end
+
+        def recover(self):
+            self._stats["recovers"] += 1
+            raise _Completed200()
+
+    def fake_factory(client, bucket_name, blob_name, mv, chunk_bytes, transport_factory=None):
+        return _LostAckSession(blobs, blob_name, mv, chunk_bytes, {}, stats)
+
+    monkeypatch.setattr(gcs_mod, "_make_resumable_session", fake_factory)
+    plugin = GCSStoragePlugin(root="bucket")
+    with knobs.override_gcs_chunk_bytes(1024):
+        _run(plugin.write(WriteIO(path="acked", buf=payload)))
+    _run(plugin.close())
+    assert blobs["acked"] == payload
+    assert stats["recovers"] == 1

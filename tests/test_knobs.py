@@ -90,3 +90,80 @@ def test_scheduler_concurrency_knobs_floor_at_one() -> None:
     with knobs.override_staging_threads(0), knobs.override_max_concurrent_io(-3):
         assert knobs.get_staging_threads() == 1
         assert knobs.get_max_concurrent_io() == 1
+
+
+def test_io_concurrency_scales_with_local_world_size() -> None:
+    from torchsnapshot_tpu.utils import knobs
+
+    assert knobs.get_local_world_size() == 1
+    try:
+        knobs.set_local_world_size(4)
+        # Local-disk defaults divide so co-hosted ranks collectively keep
+        # ~16 ops / ~2 O_DIRECT streams against the shared disk; network
+        # backends (no shared_local_device) keep the full default.
+        assert knobs.get_max_concurrent_io(shared_local_device=True) == 4
+        assert knobs.get_max_concurrent_io() == 16
+        assert knobs.get_direct_io_concurrency() == 1
+        knobs.set_local_world_size(32)
+        assert knobs.get_max_concurrent_io(shared_local_device=True) == 1  # floor at one
+        # An explicit env value is used verbatim, never scaled.
+        with knobs.override_max_concurrent_io(16):
+            assert knobs.get_max_concurrent_io(shared_local_device=True) == 16
+        with knobs._override_env(knobs._ENV_DIRECT_IO_CONCURRENCY, "2"):
+            assert knobs.get_direct_io_concurrency() == 2
+    finally:
+        knobs.set_local_world_size(1)
+    assert knobs.get_max_concurrent_io() == 16
+
+
+def test_derive_local_world_size() -> None:
+    import socket
+
+    from torchsnapshot_tpu.scheduler import derive_local_world_size
+    from torchsnapshot_tpu.utils import knobs
+
+    class FakeCoord:
+        def __init__(self, hostnames):
+            self._hostnames = hostnames
+
+        def get_world_size(self):
+            return len(self._hostnames)
+
+        def all_gather_object(self, obj):
+            return list(self._hostnames)
+
+    me = socket.gethostname()
+    try:
+        assert derive_local_world_size(FakeCoord([me, me, "other", me])) == 3
+        assert knobs.get_local_world_size() == 3
+        # Coordinator-less calls reuse the cached coordinated value.
+        assert derive_local_world_size(None) == 3
+        # A single-rank coordinated call resets to 1.
+        assert derive_local_world_size(FakeCoord([me])) == 1
+        assert knobs.get_local_world_size() == 1
+    finally:
+        knobs.set_local_world_size(1)
+
+
+def test_budget_override_still_derives_local_world_size() -> None:
+    """Setting the memory-budget env var must not silently disable
+    IO-concurrency scaling: the local-world derivation runs regardless."""
+    import socket
+
+    from torchsnapshot_tpu.scheduler import get_process_memory_budget_bytes
+    from torchsnapshot_tpu.utils import knobs
+
+    class FakeCoord:
+        def get_world_size(self):
+            return 4
+
+        def all_gather_object(self, obj):
+            return [socket.gethostname()] * 4
+
+    try:
+        with knobs.override_memory_budget_bytes(123):
+            assert get_process_memory_budget_bytes(FakeCoord()) == 123
+        assert knobs.get_local_world_size() == 4
+        assert knobs.get_max_concurrent_io(shared_local_device=True) == 4
+    finally:
+        knobs.set_local_world_size(1)

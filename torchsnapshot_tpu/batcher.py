@@ -384,8 +384,17 @@ class BatchedBufferConsumer(BufferConsumer):
         )
 
 
-def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
-    """Merge exactly-adjacent byte-range reads per object into single reads."""
+def batch_read_requests(
+    read_reqs: List[ReadReq], max_merged_bytes: Optional[int] = None
+) -> List[ReadReq]:
+    """Merge exactly-adjacent byte-range reads per object into single reads.
+
+    ``max_merged_bytes`` caps each merged run so budget-capped sub-reads
+    (``buffer_size_limit_bytes``) are never coalesced back into the
+    whole-object read they were split to avoid; a single request larger
+    than the cap still passes through whole (the usual one-over-budget
+    escape hatch).
+    """
     ranged: Dict[str, List[ReadReq]] = {}
     passthrough: List[ReadReq] = []
     for req in read_reqs:
@@ -419,7 +428,13 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
             )
 
         for req in reqs:
-            if run and req.byte_range[0] != run[-1].byte_range[1]:
+            if run and (
+                req.byte_range[0] != run[-1].byte_range[1]
+                or (
+                    max_merged_bytes is not None
+                    and req.byte_range[1] - run[0].byte_range[0] > max_merged_bytes
+                )
+            ):
                 close_run()
                 run = []
             run.append(req)

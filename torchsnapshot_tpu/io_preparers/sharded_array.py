@@ -29,7 +29,7 @@ import numpy as np
 
 from ..io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry, Shard, ShardedArrayEntry
-from ..serialization import Serializer, array_from_bytes
+from ..serialization import Serializer, array_from_bytes, string_to_dtype
 from ..utils import knobs
 from .array import ArrayIOPreparer
 
@@ -74,13 +74,19 @@ def local_unique_shards(arr: Any) -> List[Tuple[Any, List[int], List[int], int]]
 
 
 def subdivide(
-    offsets: List[int], sizes: List[int], itemsize: int, max_bytes: int
+    offsets: List[int],
+    sizes: List[int],
+    itemsize: int,
+    max_bytes: int,
+    dim: Optional[int] = None,
 ) -> List[Tuple[List[int], List[int]]]:
-    """Split a shard into <=max_bytes pieces along its largest dim."""
+    """Split a shard into <=max_bytes pieces along ``dim`` (default: its
+    largest dim). Callers that need byte-contiguous pieces pass ``dim=0``."""
     nbytes = int(np.prod(sizes)) * itemsize if sizes else itemsize
     if nbytes <= max_bytes or not sizes:
         return [(offsets, sizes)]
-    dim = int(np.argmax(sizes))
+    if dim is None:
+        dim = int(np.argmax(sizes))
     other = int(np.prod(sizes)) // max(sizes[dim], 1) * itemsize
     rows = max(1, max_bytes // max(other, 1))
     pieces = []
@@ -125,26 +131,32 @@ def _budgeted_pieces(
     contiguous byte range. A single row wider than the budget is admitted
     whole — the same one-over-budget escape hatch the scheduler uses.
     """
-    from ..serialization import string_to_dtype
 
     entry = shard.tensor
-    if entry.serializer != Serializer.RAW or not shard.sizes:
+    if (
+        entry.serializer != Serializer.RAW
+        or not shard.sizes
+        or buffer_size_limit_bytes is None
+    ):
         return [(shard.offsets, shard.sizes, None)]
     itemsize = string_to_dtype(entry.dtype).itemsize
-    nbytes = int(np.prod(shard.sizes)) * itemsize
-    if buffer_size_limit_bytes is None or nbytes <= buffer_size_limit_bytes:
+    pieces = subdivide(
+        shard.offsets, shard.sizes, itemsize, buffer_size_limit_bytes, dim=0
+    )
+    if len(pieces) == 1:
         return [(shard.offsets, shard.sizes, None)]
-    row_bytes = int(np.prod(shard.sizes[1:])) * itemsize if len(shard.sizes) > 1 else itemsize
-    rows_per_read = max(1, buffer_size_limit_bytes // max(row_bytes, 1))
-    pieces: List[Tuple[List[int], List[int], Optional[Tuple[int, int]]]] = []
-    for r0 in range(0, shard.sizes[0], rows_per_read):
-        r1 = min(r0 + rows_per_read, shard.sizes[0])
-        off = list(shard.offsets)
-        sz = list(shard.sizes)
-        off[0] = shard.offsets[0] + r0
-        sz[0] = r1 - r0
-        pieces.append((off, sz, (r0 * row_bytes, r1 * row_bytes)))
-    return pieces
+    row_bytes = int(np.prod(shard.sizes[1:])) * itemsize
+    return [
+        (
+            off,
+            sz,
+            (
+                (off[0] - shard.offsets[0]) * row_bytes,
+                (off[0] - shard.offsets[0] + sz[0]) * row_bytes,
+            ),
+        )
+        for off, sz in pieces
+    ]
 
 
 class ShardedArrayBufferConsumer(BufferConsumer):
@@ -248,11 +260,6 @@ class ShardedArrayIOPreparer:
         """
         read_reqs: List[ReadReq] = []
         for shard in entry.shards:
-            if not any(
-                overlap(shard.offsets, shard.sizes, dst_off, dst_sz)
-                for _, dst_off, dst_sz in targets
-            ):
-                continue
             base = tuple(shard.tensor.byte_range) if shard.tensor.byte_range else None
             for sub_off, sub_sz, byte_range in _budgeted_pieces(
                 shard, buffer_size_limit_bytes

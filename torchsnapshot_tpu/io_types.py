@@ -112,6 +112,12 @@ class StoragePlugin(abc.ABC):
     error.
     """
 
+    # Local-disk backends set this True so the scheduler's default IO
+    # concurrency divides across co-hosted ranks (they share one device);
+    # network/object stores keep the full default (latency-hiding
+    # concurrency, not seek-bound).
+    scales_io_with_local_world = False
+
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None:
         ...

@@ -414,11 +414,14 @@ class Snapshot:
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
         rank = coord.get_rank()
+        # Before any storage IO: the metadata read below would otherwise
+        # freeze the FS plugin's O_DIRECT stream cap at the unscaled default
+        # in a fresh (restore-only) process.
+        memory_budget = get_process_memory_budget_bytes(coord)
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
         try:
             metadata = self._read_metadata(storage, event_loop)
             manifest = get_manifest_for_rank(metadata, rank)
-            memory_budget = get_process_memory_budget_bytes(coord)
 
             # Restore RNG last so loading other statefuls can't perturb it.
             keys = self._gather_keys(dict(app_state), coord)
@@ -478,7 +481,9 @@ class Snapshot:
         if knobs.is_batching_enabled():
             from .batcher import batch_read_requests
 
-            read_reqs = batch_read_requests(read_reqs)
+            read_reqs = batch_read_requests(
+                read_reqs, max_merged_bytes=_memory_budget_bytes_per_read
+            )
 
         sync_execute_read_reqs(
             read_reqs=read_reqs,
@@ -625,7 +630,7 @@ class Snapshot:
                 # IO knob AND a memory budget: 16 concurrent full-object
                 # reads of 512 MB shards would otherwise buffer ~8 GB — an
                 # OOM on the small operator VMs this audit targets.
-                sem = asyncio.Semaphore(_knobs.get_max_concurrent_io())
+                sem = asyncio.Semaphore(_knobs.get_max_concurrent_io_for(storage))
                 budget_total = get_process_memory_budget_bytes(None)
                 avail = budget_total
                 cond = asyncio.Condition()
@@ -856,7 +861,7 @@ def _read_checksum_sidecars(
         # fire 1024 simultaneous cloud requests (throttling would surface
         # as silently-skipped sidecars, i.e. spurious 'unverified'/'no
         # digests' outcomes).
-        sem = asyncio.Semaphore(knobs.get_max_concurrent_io())
+        sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
 
         async def read_one(rank: int):
             async with sem:

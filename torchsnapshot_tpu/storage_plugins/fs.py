@@ -32,13 +32,18 @@ from ..utils import knobs
 
 
 class FSStoragePlugin(StoragePlugin):
+    scales_io_with_local_world = True  # co-hosted ranks share this disk
+
     def __init__(self, root: str) -> None:
         self.root = root
         self._dir_cache: Set[str] = set()
         self._executor: Optional[ThreadPoolExecutor] = None
         # threading (not asyncio) semaphore: held inside executor threads, so
-        # it works no matter which event loop drives the plugin.
-        self._direct_sem = threading.Semaphore(knobs.get_direct_io_concurrency())
+        # it works no matter which event loop drives the plugin. Created
+        # lazily: plugins are constructed before the take's coordinator
+        # derives the local world size, and the stream cap must reflect it.
+        self._direct_sem: Optional[threading.Semaphore] = None
+        self._sem_lock = threading.Lock()
 
     @property
     def _native(self):
@@ -52,6 +57,15 @@ class FSStoragePlugin(StoragePlugin):
         if dir_path and dir_path not in self._dir_cache:
             os.makedirs(dir_path, exist_ok=True)
             self._dir_cache.add(dir_path)
+
+    def _get_direct_sem(self) -> threading.Semaphore:
+        if self._direct_sem is None:
+            with self._sem_lock:
+                if self._direct_sem is None:
+                    self._direct_sem = threading.Semaphore(
+                        knobs.get_direct_io_concurrency()
+                    )
+        return self._direct_sem
 
     def _get_executor(self) -> ThreadPoolExecutor:
         if self._executor is None:
@@ -80,7 +94,7 @@ class FSStoragePlugin(StoragePlugin):
                 lib = self._native
 
                 def work() -> None:
-                    with self._direct_sem:
+                    with self._get_direct_sem():
                         native.write_file(
                             lib,
                             tmp_path,
@@ -149,7 +163,7 @@ class FSStoragePlugin(StoragePlugin):
         def work() -> bytearray:
             n = native.file_size(lib, path) - offset if nbytes is None else nbytes
             out = bytearray(n)
-            with self._direct_sem:
+            with self._get_direct_sem():
                 native.read_into(
                     lib,
                     path,
