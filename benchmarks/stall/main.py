@@ -1,0 +1,116 @@
+"""Async-take stall decomposition at world size > 1.
+
+The headline metric of the framework is the training stall of
+``Snapshot.async_take`` — planning plus mutable-host-state capture, NOT
+checkpoint size (device bytes drain in the background). This harness measures
+that stall *with the sharded path fully engaged*: N spawned processes form a
+real multi-process jax CPU runtime (2 virtual devices each, the analogue of
+the reference's multi-rank benches on gloo), a train-state-shaped pytree is
+sharded over the global (dp, tp) mesh, and each rank reports its stall and
+its per-phase decomposition (key gather, prepare, partition, manifest
+gather, capture/device-fork) from ``torchsnapshot_tpu.snapshot``'s phase
+timings.
+
+  python benchmarks/stall/main.py --nproc 4 --mb-per-rank 64 --steps 3
+
+Reference model: the stall claim in ``BASELINE.json`` (7B FSDP-style model,
+<5 s stall); the reference measures coordination overhead only implicitly in
+``benchmarks/ddp/`` wall times.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _worker(rank: int, world_size: int, shared: str, mb_per_rank: int, steps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import snapshot as snapshot_mod
+
+    devices = np.array(jax.devices()).reshape(world_size, -1)
+    mesh = Mesh(devices, ("dp", "tp"))
+    n_dev = devices.size
+
+    # Train-state shape: params sharded over tp, adam-style moments likewise,
+    # plus a replicated scalar step and per-rank host progress.
+    total_elems = mb_per_rank * world_size * 1024 * 1024 // 4 // 3
+    dim = int(np.sqrt(total_elems / 4))
+    dim = max(n_dev, dim - dim % n_dev)
+    # Same key on every process: device_put of a multi-process global array
+    # requires identical host values everywhere.
+    key = jax.random.PRNGKey(0)
+
+    def mk(spec):
+        return jax.device_put(
+            jax.random.normal(key, (dim, 4 * dim), dtype=jnp.float32),
+            NamedSharding(mesh, spec),
+        )
+
+    params = mk(P("dp", "tp"))
+    mu = mk(P("dp", "tp"))
+    nu = mk(P("dp", "tp"))
+    app = {
+        "train": StateDict(params=params, mu=mu, nu=nu, step=0),
+        "progress": StateDict(rank=rank),
+    }
+
+    stalls = []
+    phase_sums: dict = {}
+    for step in range(steps):
+        path = os.path.join(shared, f"ckpt_{step}")
+        t0 = time.perf_counter()
+        pending = Snapshot.async_take(path, app, replicated=["train/step"])
+        stall = time.perf_counter() - t0
+        pending.wait()
+        stalls.append(stall)
+        for k, v in getattr(snapshot_mod, "LAST_TAKE_PHASES", {}).items():
+            phase_sums.setdefault(k, []).append(v)
+
+    # First take pays one-time costs (jit warmup, pool spinup): report both.
+    result = {
+        "rank": rank,
+        "world_size": world_size,
+        "devices": n_dev,
+        "bytes_per_rank": int(3 * dim * 4 * dim * 4 / world_size),
+        "stall_first_s": round(stalls[0], 4),
+        "stall_steady_s": round(min(stalls[1:]) if len(stalls) > 1 else stalls[0], 4),
+        "phases_last_s": {k: round(v[-1], 4) for k, v in phase_sums.items()},
+    }
+    with open(os.path.join(shared, f"result_{rank}.json"), "w") as f:
+        json.dump(result, f)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nproc", type=int, default=4)
+    parser.add_argument("--mb-per-rank", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=3)
+    args = parser.parse_args()
+
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    with tempfile.TemporaryDirectory() as shared:
+        run_with_processes(
+            _worker,
+            nproc=args.nproc,
+            init_jax_distributed=True,
+            args=(shared, args.mb_per_rank, args.steps),
+            timeout_s=900,
+        )
+        for rank in range(args.nproc):
+            with open(os.path.join(shared, f"result_{rank}.json")) as f:
+                print(json.dumps(json.load(f)))
+
+
+if __name__ == "__main__":
+    main()

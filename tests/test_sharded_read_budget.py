@@ -109,3 +109,29 @@ def test_restore_unaffected_by_subdivided_save(tmp_path) -> None:
         path, host = _take_sharded(tmp_path)
     got = Snapshot(path).read_object("0/s/w", memory_budget_bytes=200)
     assert np.array_equal(got, host)
+
+
+def test_restore_splits_reads_larger_than_process_budget(tmp_path, monkeypatch) -> None:
+    """Full restore also byte-range-splits any single read larger than the
+    process memory budget — the scheduler's one-over-budget escape hatch
+    must never admit a whole shard bigger than the budget."""
+    path, host = _take_sharded(tmp_path)  # 8 shards x 1024 B
+
+    read_sizes = []
+    orig_read = FSStoragePlugin.read
+
+    async def spying_read(self, read_io):
+        await orig_read(self, read_io)
+        if "sharded/" in read_io.path:
+            read_sizes.append(len(read_io.buf.getbuffer()))
+
+    monkeypatch.setattr(FSStoragePlugin, "read", spying_read)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    live = jax.device_put(
+        jnp.zeros((64, 32), jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    tgt = StateDict(w=live)
+    with knobs.override_memory_budget_bytes(512):
+        Snapshot(path).restore({"s": tgt})
+    assert np.asarray(tgt["w"]).view(np.uint8).tobytes() == host.view(np.uint8).tobytes()
+    assert read_sizes and max(read_sizes) <= 512

@@ -36,6 +36,7 @@ import fnmatch
 import logging
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -82,6 +83,14 @@ from .utils import knobs
 from .version import __version__
 
 logger = logging.getLogger(__name__)
+
+# Stall decomposition of this process's most recent take/async_take: phase
+# name -> seconds (gather_keys_and_flatten, prepare_write, partition,
+# d2h_hint, manifest_gather, memory_budget, capture). The stall IS these
+# phases — device bytes drain in the background — so regressions here are
+# regressions of the headline metric. Diagnostics only: overwritten per
+# take, per process.
+LAST_TAKE_PHASES: Dict[str, float] = {}
 
 
 class Snapshot:
@@ -221,6 +230,14 @@ class Snapshot:
     ) -> Tuple[PendingIOWork, SnapshotMetadata]:
         rank = coord.get_rank()
         world_size = coord.get_world_size()
+        phases: Dict[str, float] = {}
+        phase_t0 = time.monotonic()
+
+        def _phase(name: str) -> None:
+            nonlocal phase_t0
+            now = time.monotonic()
+            phases[name] = now - phase_t0
+            phase_t0 = now
 
         # RNG invariant: capture host RNG state before anything else can
         # advance it, and reinstate it after the take completes, so that a
@@ -251,6 +268,7 @@ class Snapshot:
             # Every rank must hit this barrier — including ranks that don't
             # own `key` — or the collective generation counters desync.
             coord.barrier()
+        _phase("gather_keys_and_flatten")
 
         replicated_paths = cls._match_replicated_paths(
             set(flattened.keys()), replicated_globs
@@ -263,6 +281,7 @@ class Snapshot:
             is_async_snapshot=is_async_snapshot,
         )
         manifest.update(local_manifest)
+        _phase("prepare_write")
 
         write_reqs = partition_write_reqs(manifest, write_reqs, coord)
 
@@ -271,6 +290,7 @@ class Snapshot:
 
             entries = list(manifest.values())
             _, write_reqs = batch_write_requests(entries, write_reqs)
+        _phase("partition")
 
         if is_async_snapshot and knobs.is_async_eager_d2h_enabled():
             # Post-partition, so DMAs start only for the bytes THIS rank
@@ -279,6 +299,7 @@ class Snapshot:
             for req in write_reqs:
                 if req.defer_staging:
                     req.buffer_stager.start_d2h_hint()
+        _phase("d2h_hint")
 
         global_manifest = cls._gather_manifest(manifest, coord)
         # None on non-zero ranks: only the committing rank holds the global
@@ -290,8 +311,10 @@ class Snapshot:
             if global_manifest is not None
             else None
         )
+        _phase("manifest_gather")
 
         memory_budget = get_process_memory_budget_bytes(coord)
+        _phase("memory_budget")
         if base and not (
             knobs.is_checksums_enabled() and knobs.is_dedup_digests_enabled()
         ):
@@ -334,11 +357,14 @@ class Snapshot:
             event_loop=event_loop,
             base_loader=base_loader,
         )
+        _phase("capture")
 
         # Reinstate the pre-take RNG state (taking a snapshot must not
         # perturb the program's randomness).
         for _, stateful, state in rng_states:
             stateful.load_state_dict(state)
+        LAST_TAKE_PHASES.clear()
+        LAST_TAKE_PHASES.update(phases)
         return pending_io_work, metadata
 
     @classmethod
@@ -452,8 +478,13 @@ class Snapshot:
         storage: StoragePlugin,
         memory_budget: int,
         event_loop: asyncio.AbstractEventLoop,
-        _memory_budget_bytes_per_read: Optional[int] = None,
     ) -> None:
+        # Per-read cap = the whole process budget: a single object/shard
+        # larger than the budget would otherwise be admitted whole through
+        # the scheduler's one-over-budget escape hatch — the RSS spike the
+        # byte-range sub-read machinery exists to prevent. Reads within the
+        # budget stay whole and are paced by the scheduler as usual.
+        _memory_budget_bytes_per_read = memory_budget
         # Live values serve as in-place targets (np) or sharding donors (jax).
         _, live_flattened = flatten(stateful.state_dict(), prefix=key)
 
@@ -1073,6 +1104,7 @@ class PendingSnapshot:
         self.path = path
         self._coord = coord
         self._metadata = metadata
+        self._pending_io_work = pending_io_work
         PendingSnapshot._seq += 1
         self._barrier_id = f"async_commit/{PendingSnapshot._seq}/{path}"
         self._exc: Optional[BaseException] = None
@@ -1135,3 +1167,13 @@ class PendingSnapshot:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def drain_stats(self) -> Dict[str, float]:
+        """Overlap accounting of the background drain (empty until the
+        snapshot commits): wall_s, stage_busy_s (D2H+serialize in flight),
+        io_busy_s (storage writes in flight), overlap_s (both), idle_s.
+        Low overlap relative to the shorter stream means the drain
+        serialized D2H against storage writes — the thing to tune at
+        multi-GB checkpoint scale."""
+        return self._pending_io_work.drain_stats

@@ -323,6 +323,12 @@ class _GoogleResumableSession:
         upload_url = (
             f"{api_base}/upload/storage/v1/b/{bucket_name}/o?uploadType=resumable"
         )
+        # The wire protocol requires 256 KiB-multiple chunks; round up here
+        # (the real-session layer) so any knob value works — passing a raw
+        # sub-multiple would raise a non-transient ValueError on the first
+        # large write.
+        quantum = 256 * 1024
+        chunk_bytes = max(quantum, (chunk_bytes + quantum - 1) // quantum * quantum)
         self._upload = ResumableUpload(upload_url, chunk_bytes)
         self._upload.initiate(
             self._transport,

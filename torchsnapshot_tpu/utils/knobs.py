@@ -145,18 +145,13 @@ def get_gcs_chunk_bytes() -> int:
     """Chunk size for GCS resumable uploads (reference used 100 MB).
 
     Objects larger than one chunk upload via a resumable session with
-    write-cursor recovery; smaller ones use a one-shot PUT. The protocol
-    requires a multiple of 256 KiB, so env values above the quantum are
-    rounded up here — deferring that to the upload path would fail the
-    first large write with an opaque non-transient ValueError. Sub-quantum
-    values pass through untouched (only meaningful with fake backends in
-    tests; real GCS rejects them at initiate time).
+    write-cursor recovery; smaller ones use a one-shot PUT. The GCS wire
+    protocol requires 256 KiB-multiple chunks; the real upload session
+    rounds up to that quantum itself (``_GoogleResumableSession``), so any
+    positive value here works — this getter only sets the
+    resumable-vs-one-shot threshold and the requested chunk granularity.
     """
-    quantum = 256 * 1024
-    raw = _get_int(_ENV_GCS_CHUNK, 100 * 1024 * 1024)
-    if raw <= quantum:
-        return raw
-    return (raw + quantum - 1) // quantum * quantum
+    return max(1, _get_int(_ENV_GCS_CHUNK, 100 * 1024 * 1024))
 
 
 def override_gcs_chunk_bytes(value: int):
