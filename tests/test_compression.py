@@ -1,0 +1,296 @@
+"""Opt-in array-payload compression (``TORCHSNAPSHOT_TPU_COMPRESSION``).
+
+The incumbent TPU checkpointer compresses (orbax/TensorStore OCDBT writes
+zstd'd chunks, measured 1.4x on bf16 noise); this is the equivalent
+capability here: raw byte streams compressed whole per storage object, with
+the serializer recorded per entry so restore auto-detects and mixed
+snapshots coexist.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.serialization import Serializer
+from torchsnapshot_tpu.test_utils import rand_array
+from torchsnapshot_tpu.utils import knobs
+
+
+def _app():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    sharded = jax.device_put(
+        jnp.asarray(np.arange(64 * 32, dtype=np.float32).reshape(64, 32)),
+        NamedSharding(mesh, P("x")),
+    )
+    return {
+        "m": StateDict(
+            f32=np.arange(4096, dtype=np.float32).reshape(64, 64),
+            bf16=jnp.ones((128, 8), jnp.bfloat16) * 3,
+            i64=np.arange(100),
+            sharded=sharded,
+            obj={1, "two"},  # sets stay opaque -> pickle ObjectEntry
+            scalar=7,
+        )
+    }
+
+
+def _assert_restored(path, app) -> None:
+    src = app["m"]
+    tgt = StateDict(
+        f32=np.zeros((64, 64), np.float32),
+        bf16=jnp.zeros((128, 8), jnp.bfloat16),
+        i64=np.zeros(100, np.int64),
+        sharded=jnp.zeros((64, 32), jnp.float32),
+        obj=None,
+        scalar=0,
+    )
+    Snapshot(path).restore({"m": tgt})
+    assert np.array_equal(tgt["f32"], src["f32"])
+    assert np.asarray(tgt["bf16"]).view(np.uint8).tobytes() == np.asarray(src["bf16"]).view(np.uint8).tobytes()
+    assert np.array_equal(tgt["i64"], src["i64"])
+    assert np.array_equal(np.asarray(tgt["sharded"]), np.asarray(src["sharded"]))
+    assert tgt["obj"] == {1, "two"}
+    assert tgt["scalar"] == 7
+
+
+def _tree_bytes(root: str) -> int:
+    total = 0
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            total += os.path.getsize(os.path.join(dirpath, f))
+    return total
+
+
+@pytest.mark.parametrize("codec,serializer", [("zstd", Serializer.RAW_ZSTD), ("zlib", Serializer.RAW_ZLIB)])
+def test_compressed_roundtrip(tmp_path, codec, serializer) -> None:
+    app = _app()
+    path = str(tmp_path / codec)
+    with knobs.override_compression(codec):
+        Snapshot.take(path, app)
+    manifest = Snapshot(path).get_manifest()
+    assert manifest["0/m/f32"].serializer == serializer
+    for shard in manifest["0/m/sharded"].shards:
+        assert shard.tensor.serializer == serializer
+    assert manifest["0/m/obj"].type == "object"  # pickle path unaffected
+    # Restore without the knob: serializer is read from the entry.
+    _assert_restored(path, app)
+    assert Snapshot(path).verify() == {}
+
+
+def test_compression_shrinks_storage(tmp_path) -> None:
+    app = _app()  # arange/ones data: highly compressible
+    plain = str(tmp_path / "plain")
+    comp = str(tmp_path / "comp")
+    Snapshot.take(plain, app)
+    with knobs.override_compression("zstd"):
+        Snapshot.take(comp, app)
+    assert _tree_bytes(comp) < _tree_bytes(plain) * 0.7
+
+
+def test_compressed_read_object_ignores_byte_budget_correctly(tmp_path) -> None:
+    """Compressed entries are not byte-range addressable: read_object with a
+    budget still returns exact data via whole-object reads."""
+    app = _app()
+    path = str(tmp_path / "c")
+    with knobs.override_compression("zstd"):
+        Snapshot.take(path, app)
+    got = Snapshot(path).read_object("0/m/sharded", memory_budget_bytes=64)
+    assert np.array_equal(got, np.asarray(app["m"]["sharded"]))
+    got = Snapshot(path).read_object("0/m/f32", memory_budget_bytes=64)
+    assert np.array_equal(got, app["m"]["f32"])
+
+
+def test_compressed_chunked_roundtrip(tmp_path) -> None:
+    with knobs.override_max_chunk_size_bytes(1024), knobs.override_compression("zstd"):
+        arr = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+        path = str(tmp_path / "c")
+        Snapshot.take(path, {"s": StateDict(a=arr)})
+        entry = Snapshot(path).get_manifest()["0/s/a"]
+        assert entry.type == "chunked_array" and len(entry.chunks) > 1
+        assert entry.chunks[0].tensor.serializer == Serializer.RAW_ZSTD
+    tgt = StateDict(a=np.zeros((64, 32), np.float32))
+    Snapshot(path).restore({"s": tgt})
+    assert np.array_equal(tgt["a"], arr)
+
+
+def test_compression_composes_with_batching(tmp_path) -> None:
+    """Slab batching only coalesces uncompressed raw entries; with
+    compression on, entries pass through unbatched and stay correct."""
+    app = _app()
+    path = str(tmp_path / "b")
+    with knobs.override_batching_enabled(True), knobs.override_slab_size_threshold_bytes(1 << 20):
+        with knobs.override_compression("zstd"):
+            Snapshot.take(path, app)
+        _assert_restored(path, app)
+        manifest = Snapshot(path).get_manifest()
+        assert not any(
+            getattr(e, "location", "").startswith("batched/")
+            for e in manifest.values()
+            if hasattr(e, "location")
+        )
+
+
+def test_compression_composes_with_incremental_dedup(tmp_path) -> None:
+    """Byte-identical compressed objects dedup against a base snapshot
+    (zstd is deterministic for a fixed level/version)."""
+    frozen = {f"b{i}": np.arange(2000, dtype=np.float32) + i for i in range(3)}
+
+    def app(step):
+        return {"m": StateDict(**frozen, head=np.full((10,), step, np.float32))}
+
+    s0 = str(tmp_path / "s0")
+    s1 = str(tmp_path / "s1")
+    with knobs.override_compression("zstd"):
+        Snapshot.take(s0, app(0))
+        Snapshot.take(s1, app(1), base=s0)
+    # Hard links: deduped objects share inodes with the base.
+    import os as _os
+
+    linked = 0
+    for i in range(3):
+        a = _os.path.join(s0, "0", "m", f"b{i}")
+        b = _os.path.join(s1, "0", "m", f"b{i}")
+        if _os.path.exists(a) and _os.path.exists(b) and _os.path.samefile(a, b):
+            linked += 1
+    assert linked == 3
+    tgt = StateDict(**{k: np.zeros(2000, np.float32) for k in frozen}, head=np.zeros(10, np.float32))
+    Snapshot(s1).restore({"m": tgt})
+    assert np.array_equal(tgt["head"], np.full((10,), 1, np.float32))
+
+
+def test_exotic_dtypes_compress(tmp_path) -> None:
+    arrays = {d: rand_array((32, 8), d, seed=1) for d in ("bfloat16", "float8_e4m3fn", "int4", "uint16")}
+    path = str(tmp_path / "d")
+    with knobs.override_compression("zstd"):
+        Snapshot.take(path, {"s": StateDict(**arrays)})
+    tgt = StateDict(**{k: np.zeros_like(v) for k, v in arrays.items()})
+    Snapshot(path).restore({"s": tgt})
+    for k, v in arrays.items():
+        assert tgt[k].view(np.uint8).tobytes() == v.view(np.uint8).tobytes(), k
+
+
+def test_invalid_codec_rejected() -> None:
+    with knobs._override_env(knobs._ENV_COMPRESSION, "lz77"):
+        with pytest.raises(ValueError, match="lz77"):
+            knobs.get_compression()
+
+
+def test_missing_zstandard_fails_fast(monkeypatch) -> None:
+    """A zstd knob without the zstandard package must fail at knob-read
+    (take time), not ModuleNotFoundError in the background drain."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_zstd(name, *args, **kwargs):
+        if name == "zstandard":
+            raise ImportError(name)
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_zstd)
+    with knobs.override_compression("zstd"):
+        with pytest.raises(RuntimeError, match="zstandard"):
+            knobs.get_compression()
+
+
+def test_compression_level_validated_per_codec() -> None:
+    with knobs.override_compression("zlib"), knobs.override_compression_level(12):
+        with pytest.raises(ValueError, match="out of range"):
+            knobs.get_compression()
+    with knobs.override_compression("zstd"), knobs.override_compression_level(12):
+        assert knobs.get_compression() == "zstd"
+        assert knobs.get_compression_level() == 12
+    # Stale level env with compression off never raises — numeric or not.
+    with knobs.override_compression("none"), knobs.override_compression_level(99):
+        assert knobs.get_compression() == "none"
+    with knobs.override_compression("none"), knobs._override_env(
+        knobs._ENV_COMPRESSION_LEVEL, "fast"
+    ):
+        assert knobs.get_compression() == "none"
+        assert knobs.get_compression_level() == 1
+
+
+def test_compressed_staging_costs_account_double() -> None:
+    from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer, entry_cost_bytes
+
+    arr = np.zeros((256, 256), np.float32)  # 256 KiB raw
+    with knobs.override_compression("zstd"):
+        entry, reqs = ArrayIOPreparer.prepare_write("p", arr)
+    assert entry.serializer == Serializer.RAW_ZSTD
+    assert reqs[0].buffer_stager.get_staging_cost_bytes() == 2 * arr.nbytes
+    assert entry_cost_bytes(entry) == 2 * arr.nbytes
+    entry_plain, reqs_plain = ArrayIOPreparer.prepare_write("p", arr)
+    assert reqs_plain[0].buffer_stager.get_staging_cost_bytes() == arr.nbytes
+
+
+def test_stage_level_keyed_by_entry_not_env(tmp_path) -> None:
+    """An entry recorded under one codec compresses correctly even if the
+    env codec/level changed before its (deferred) staging ran."""
+    from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+
+    arr = np.arange(1024, dtype=np.float32)
+    with knobs.override_compression("zstd"), knobs.override_compression_level(15):
+        entry, reqs = ArrayIOPreparer.prepare_write("p", arr)
+    assert entry.serializer == Serializer.RAW_ZSTD
+    assert reqs[0].buffer_stager.compression_level == 15
+    # Env now says zlib (level 15 would be invalid for it) — staging must
+    # use the codec and level captured at prepare time.
+    import asyncio
+
+    with knobs.override_compression("zlib"), knobs.override_compression_level(15):
+        buf = asyncio.new_event_loop().run_until_complete(
+            reqs[0].buffer_stager.stage_buffer()
+        )
+    from torchsnapshot_tpu.serialization import decode_raw_payload
+
+    raw = decode_raw_payload(buf, Serializer.RAW_ZSTD)
+    assert np.array_equal(np.frombuffer(raw, np.float32), arr)
+
+
+def test_async_host_arrays_safe_to_mutate_after_compressed_take(tmp_path) -> None:
+    """The RAW path defensively copies mutable host arrays for async takes;
+    compressed payloads are consumed inside staging, so mutating the live
+    array after async_take returns must not corrupt the snapshot."""
+    live = np.arange(4096, dtype=np.float32)
+    want = live.copy()
+    path = str(tmp_path / "c")
+    with knobs.override_compression("zstd"):
+        pending = Snapshot.async_take(path, {"s": StateDict(a=live)})
+        live += 1000.0  # mutate immediately after return
+        pending.wait()
+    tgt = StateDict(a=np.zeros(4096, np.float32))
+    Snapshot(path).restore({"s": tgt})
+    assert np.array_equal(tgt["a"], want)
+
+
+def test_divergent_codec_across_ranks_fails_loudly(tmp_path) -> None:
+    """A replicated entry's manifest copy on a non-writer rank must never
+    lie about the writer's bytes: codec divergence across ranks aborts the
+    take with a clear error instead of corrupting the manifest."""
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    run_with_processes(
+        _divergent_codec_worker, nproc=2, args=(str(tmp_path),), timeout_s=120
+    )
+
+
+def _divergent_codec_worker(rank, world_size, shared):
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    codec = "zstd" if rank == 0 else "none"
+    state = StateDict(w=np.arange(512, dtype=np.float32))
+    with _knobs.override_compression(codec):
+        try:
+            Snapshot.take(
+                os.path.join(shared, "ckpt"), {"m": state}, replicated=["m/*"]
+            )
+        except ValueError as e:
+            assert "TORCHSNAPSHOT_TPU_COMPRESSION" in str(e)
+        else:
+            raise AssertionError("divergent codecs did not fail the take")

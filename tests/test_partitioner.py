@@ -20,6 +20,9 @@ class _FakeCoordinator(Coordinator):
         self._gathered_loads = gathered_loads
 
     def all_gather_object(self, obj, timeout_s=None):
+        # Production gathers (load, codec) tuples; preset loads are ints.
+        if isinstance(obj, tuple):
+            return [(l, obj[1]) for l in self._gathered_loads]
         return list(self._gathered_loads)
 
 

@@ -34,9 +34,14 @@ from ..serialization import (
     array_as_bytes_view,
     array_from_bytes,
     array_nbytes,
+    compress_payload,
+    decode_raw_payload,
     dtype_to_string,
+    is_raw_family,
     is_raw_serializable,
+    raw_serializer_for_codec,
 )
+from ..utils import knobs
 
 
 def _is_jax_array(obj: Any) -> bool:
@@ -72,6 +77,15 @@ class ArrayBufferStager(BufferStager):
         self.arr = arr
         self.entry = entry
         self.is_async_snapshot = is_async_snapshot
+        # Sole owner of level resolution, at construction (== prepare
+        # time), never at stage time: a deferred background drain must not
+        # re-read knobs whose env changed since (wrong level breaks the
+        # fixed-level zstd determinism incremental dedup relies on; an
+        # invalid ambient level would raise mid-drain).
+        self.compression_level: Optional[int] = None
+        if entry.serializer in (Serializer.RAW_ZSTD, Serializer.RAW_ZLIB):
+            codec = "zstd" if entry.serializer == Serializer.RAW_ZSTD else "zlib"
+            self.compression_level = knobs.get_compression_level(_codec=codec)
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         arr = self.arr
@@ -79,20 +93,43 @@ class ArrayBufferStager(BufferStager):
             host = await to_host(arr, executor)()
         else:
             host = np.asarray(arr)
-            if self.is_async_snapshot:
-                # Host arrays stage *before* async_take returns, but the
-                # staged buffer is a zero-copy view — copy so training can
-                # mutate the live array afterwards (reference
-                # ``tensor.py:254-264``).
+            if self.is_async_snapshot and self.entry.serializer == Serializer.RAW:
+                # Host arrays stage *before* async_take returns, but the RAW
+                # staged buffer is a zero-copy view that the background
+                # write reads after training resumed — copy so training can
+                # mutate the live array meanwhile (reference
+                # ``tensor.py:254-264``). Compressed/pickled payloads are
+                # consumed synchronously inside this staging call and the
+                # output is independent bytes, so they skip the copy.
                 host = host.copy()
             elif not host.flags["C_CONTIGUOUS"]:
                 host = np.ascontiguousarray(host)
         if self.entry.serializer == Serializer.RAW:
             return array_as_bytes_view(host)
+        if is_raw_family(self.entry.serializer):
+            # Compress on the executor: seconds of zstd on a large shard
+            # must not block the event loop that dispatches every other
+            # request's transfers and writes.
+            view = array_as_bytes_view(host)
+            level = self.compression_level
+            loop = asyncio.get_event_loop()
+            if executor is not None:
+                return await loop.run_in_executor(
+                    executor, compress_payload, view, self.entry.serializer, level
+                )
+            return compress_payload(view, self.entry.serializer, level)
         return pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
 
     def get_staging_cost_bytes(self) -> int:
-        return array_nbytes(self.entry.shape, self.entry.dtype) if self.entry.serializer == Serializer.RAW else _nbytes_of(self.arr)
+        if not is_raw_family(self.entry.serializer):
+            return _nbytes_of(self.arr)
+        nbytes = array_nbytes(self.entry.shape, self.entry.dtype)
+        if self.entry.serializer != Serializer.RAW:
+            # Peak transient footprint holds the raw host bytes AND the
+            # compressed output simultaneously; incompressible data makes
+            # that ~2x raw — the budget must see the true peak.
+            return 2 * nbytes
+        return nbytes
 
     def start_d2h_hint(self) -> None:
         if _is_jax_array(self.arr):
@@ -110,22 +147,30 @@ def _nbytes_of(arr: Any) -> int:
 
 
 def entry_np_dtype(dtype: str, serializer: str) -> np.dtype:
-    """Numpy dtype for an entry: raw entries use the canonical table; pickle
-    entries recorded ``str(np.dtype)`` (e.g. ``datetime64[D]``, ``object``)."""
+    """Numpy dtype for an entry: raw-family entries use the canonical table;
+    pickle entries recorded ``str(np.dtype)`` (e.g. ``datetime64[D]``,
+    ``object``)."""
     from ..serialization import string_to_dtype
 
-    if serializer == Serializer.RAW:
+    if is_raw_family(serializer):
         return string_to_dtype(dtype)
     return np.dtype(dtype)
 
 
 def entry_cost_bytes(entry: ArrayEntry) -> int:
-    """Best-effort host-memory cost of staging/consuming one array entry."""
+    """Best-effort host-memory cost of staging/consuming one array entry.
+
+    Compressed entries cost ~2x on the consume side: the compressed buffer
+    and the decoded raw bytes coexist during decompression.
+    """
     try:
         n = 1
         for d in entry.shape:
             n *= int(d)
-        return n * entry_np_dtype(entry.dtype, entry.serializer).itemsize
+        n *= entry_np_dtype(entry.dtype, entry.serializer).itemsize
+        if is_raw_family(entry.serializer) and entry.serializer != Serializer.RAW:
+            n *= 2
+        return n
     except Exception:
         return 1024 * 1024
 
@@ -141,8 +186,9 @@ class ArrayBufferConsumer(BufferConsumer):
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def work() -> None:
-            if self.entry.serializer == Serializer.RAW:
-                src = array_from_bytes(buf, self.entry.dtype, self.entry.shape)
+            if is_raw_family(self.entry.serializer):
+                raw = decode_raw_payload(buf, self.entry.serializer)
+                src = array_from_bytes(raw, self.entry.dtype, self.entry.shape)
             else:
                 src = pickle.loads(bytes(buf))
             np.copyto(self.target, src, casting="no")
@@ -198,11 +244,14 @@ class ArrayIOPreparer:
     ) -> Tuple[ArrayEntry, List[WriteReq]]:
         host_like = arr  # dtype/shape probes work on jax and numpy alike
         dtype = np.dtype(host_like.dtype)
-        serializer = Serializer.RAW if is_raw_serializable(dtype) else Serializer.PICKLE
+        if is_raw_serializable(dtype):
+            serializer = raw_serializer_for_codec(knobs.get_compression())
+        else:
+            serializer = Serializer.PICKLE
         entry = ArrayEntry(
             location=storage_path,
             serializer=serializer,
-            dtype=dtype_to_string(dtype) if serializer == Serializer.RAW else str(dtype),
+            dtype=dtype_to_string(dtype) if is_raw_family(serializer) else str(dtype),
             shape=list(host_like.shape),
             replicated=replicated,
         )
@@ -217,12 +266,16 @@ class ArrayIOPreparer:
     ) -> List[ReadReq]:
         """Plan reads filling ``target`` (a writable host array)."""
         if entry.serializer != Serializer.RAW:
-            # Pickled arrays have no predictable byte length: read the whole
-            # object (never byte-ranged, never budget-chunked).
+            # Pickled and compressed payloads have no raw byte layout on
+            # storage: read the whole object (never budget-chunked), ranged
+            # only to a slab-relocated span if the entry records one.
             return [
                 ReadReq(
                     path=entry.location,
                     buffer_consumer=ArrayBufferConsumer(target, entry),
+                    byte_range=tuple(entry.byte_range)
+                    if entry.byte_range
+                    else None,
                 )
             ]
         base_range = entry.byte_range or [0, array_nbytes(entry.shape, entry.dtype)]

@@ -29,7 +29,13 @@ import numpy as np
 
 from ..io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry, Shard, ShardedArrayEntry
-from ..serialization import Serializer, array_from_bytes, string_to_dtype
+from ..serialization import (
+    Serializer,
+    array_from_bytes,
+    decode_raw_payload,
+    is_raw_family,
+    string_to_dtype,
+)
 from ..utils import knobs
 from .array import ArrayIOPreparer
 
@@ -175,8 +181,9 @@ class ShardedArrayBufferConsumer(BufferConsumer):
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def work() -> None:
-            if self.entry.serializer == Serializer.RAW:
-                src = array_from_bytes(buf, self.entry.dtype, self.entry.shape)
+            if is_raw_family(self.entry.serializer):
+                raw = decode_raw_payload(buf, self.entry.serializer)
+                src = array_from_bytes(raw, self.entry.dtype, self.entry.shape)
             else:
                 src = pickle.loads(bytes(buf))
             for dst, src_slices, dst_slices in self.copy_specs:

@@ -24,6 +24,7 @@ from typing import Dict, List, Tuple
 from .io_types import WriteReq
 from .manifest import Entry, Manifest, is_replicated
 from .parallel.coordinator import Coordinator
+from .utils import knobs
 
 
 def _estimate(req: WriteReq) -> int:
@@ -53,9 +54,20 @@ def partition_write_reqs(
     replicated_reqs = [r for r in write_reqs if r.path in replicated_locations]
     other_reqs = [r for r in write_reqs if r.path not in replicated_locations]
 
-    # Per-rank base load from non-replicated writes.
+    # Per-rank base load from non-replicated writes. The compression codec
+    # rides the same gather: the serializer became env-dependent, and a rank
+    # restoring a replicated entry trusts its own manifest copy — divergent
+    # codecs would make one rank's copy lie about another rank's bytes, so
+    # fail loudly at take time instead.
     local_load = sum(_estimate(r) for r in other_reqs)
-    loads: List[int] = coordinator.all_gather_object(local_load)
+    gathered = coordinator.all_gather_object((local_load, knobs.get_compression()))
+    loads: List[int] = [load for load, _ in gathered]
+    codecs = {codec for _, codec in gathered}
+    if len(codecs) > 1:
+        raise ValueError(
+            "TORCHSNAPSHOT_TPU_COMPRESSION differs across ranks "
+            f"({sorted(codecs)}); set it identically on every process"
+        )
 
     # Deterministic greedy: biggest request first onto the least-loaded rank.
     # Sort key includes the path so every rank breaks ties identically.

@@ -9,17 +9,28 @@ dtype via ``ml_dtypes``, and the uniform zero-copy path is a ``uint8`` view of
 the contiguous array (plain ``memoryview(arr)`` raises for ml_dtypes custom
 dtypes, so we never use it).
 
-Two serializers exist:
+Serializer families:
 
 - ``raw``: little-endian C-contiguous raw bytes. Used for every dtype in
   :data:`SUPPORTED_DTYPES`. Enables ranged reads (a byte range of the
   serialized buffer corresponds to a contiguous region of the flat array).
+- ``raw_zstd`` / ``raw_zlib``: the raw byte stream compressed whole. Opt-in
+  via ``TORCHSNAPSHOT_TPU_COMPRESSION`` — on links/stores slower than the
+  compressor (tunneled transports, cloud buckets, shared NVMe) the ~1.3-1.5x
+  typical ratio on trained bf16/f32 weights directly multiplies effective
+  write throughput and shrinks checkpoints. The cost: compressed objects
+  are not byte-range addressable (budgeted sub-reads and slab batching fall
+  back to whole-object reads / unbatched writes). The serializer is
+  recorded per entry, so restore auto-detects regardless of current knobs,
+  and a compressed and an uncompressed snapshot can coexist.
 - ``pickle``: ``pickle`` of arbitrary Python objects. Fallback for
   non-array leaves (reference used ``torch.save``; we have no torch
   dependency on the TPU path).
 """
 
 from __future__ import annotations
+
+import zlib
 
 import numpy as np
 
@@ -31,7 +42,53 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
 
 class Serializer:
     RAW = "raw"
+    RAW_ZSTD = "raw_zstd"
+    RAW_ZLIB = "raw_zlib"
     PICKLE = "pickle"
+
+
+# Serializers whose decoded payload is the raw little-endian byte stream
+# (dtype strings come from the canonical table, shapes are exact).
+RAW_FAMILY = (Serializer.RAW, Serializer.RAW_ZSTD, Serializer.RAW_ZLIB)
+
+
+def is_raw_family(serializer: str) -> bool:
+    return serializer in RAW_FAMILY
+
+
+def raw_serializer_for_codec(codec: str) -> str:
+    """Map a compression codec name ('none'|'zstd'|'zlib') to a serializer."""
+    if codec == "zstd":
+        return Serializer.RAW_ZSTD
+    if codec == "zlib":
+        return Serializer.RAW_ZLIB
+    return Serializer.RAW
+
+
+def compress_payload(view, serializer: str, level: int) -> bytes:
+    """Compress a raw byte view per ``serializer`` (RAW passes through)."""
+    if serializer == Serializer.RAW_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdCompressor(level=level).compress(view)
+    if serializer == Serializer.RAW_ZLIB:
+        return zlib.compress(view, level)
+    return view
+
+
+def decode_raw_payload(buf, serializer: str):
+    """Undo :func:`compress_payload`: return the raw little-endian bytes.
+
+    Decompressors take buffer-protocol objects directly — no defensive
+    ``bytes()`` copy of a possibly-100 MB compressed payload.
+    """
+    if serializer == Serializer.RAW_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(memoryview(buf))
+    if serializer == Serializer.RAW_ZLIB:
+        return zlib.decompress(memoryview(buf))
+    return buf
 
 
 def _build_dtype_table():

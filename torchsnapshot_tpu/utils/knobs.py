@@ -138,6 +138,77 @@ def override_direct_io_threshold_bytes(value: int):
     return _override_env(_ENV_DIRECT_IO_THRESHOLD, str(value))
 
 
+_ENV_COMPRESSION = "TORCHSNAPSHOT_TPU_COMPRESSION"
+_ENV_COMPRESSION_LEVEL = "TORCHSNAPSHOT_TPU_COMPRESSION_LEVEL"
+
+
+def get_compression() -> str:
+    """Array-payload compression codec: 'none' (default), 'zstd', 'zlib'.
+
+    Recorded per entry at write time (restore auto-detects), so the knob
+    only affects new takes. Worth turning on when the store/link is slower
+    than the compressor (~0.3 GB/s/thread for zstd-3): trained bf16/f32
+    weights typically compress 1.3-1.5x, multiplying effective write
+    throughput and shrinking checkpoints by the same factor. Compressed
+    objects are not byte-range addressable: budgeted sub-reads and slab
+    batching fall back to whole-object handling for them.
+
+    Stall note: device arrays compress in the background drain, but
+    *mutable host* arrays stage (and therefore compress) before
+    ``async_take`` returns — with large host-resident state, compression
+    time joins the stall. The TPU norm (params/optimizer on device, small
+    host leaves) keeps the stall unchanged.
+    """
+    val = os.environ.get(_ENV_COMPRESSION, "none").lower()
+    if val in ("", "0", "false", "off"):
+        return "none"
+    if val not in ("none", "zstd", "zlib"):
+        raise ValueError(
+            f"{_ENV_COMPRESSION}={val!r}: expected 'none', 'zstd', or 'zlib'"
+        )
+    if val == "zstd":
+        # Fail fast at knob-read (i.e. at prepare_write during take), not
+        # ModuleNotFoundError inside the background drain after async_take
+        # already returned.
+        try:
+            import zstandard  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                f"{_ENV_COMPRESSION}=zstd requires the 'zstandard' package; "
+                "install it or use 'zlib'"
+            ) from e
+    get_compression_level(_codec=val)  # range-validate alongside the codec
+    return val
+
+
+def get_compression_level(_codec: Optional[str] = None) -> int:
+    """Codec level (zstd: 1-22, default 3; zlib: 0-9, default 1)."""
+    codec = _codec if _codec is not None else get_compression()
+    val = os.environ.get(_ENV_COMPRESSION_LEVEL)
+    if codec == "none":
+        # Unused, and a stale/garbage level env must never fail a take
+        # whose compression is off — don't even parse it.
+        return 1
+    if val is None:
+        return 3 if codec == "zstd" else 1
+    level = int(val)
+    lo, hi = (1, 22) if codec == "zstd" else (0, 9)
+    if not lo <= level <= hi:
+        raise ValueError(
+            f"{_ENV_COMPRESSION_LEVEL}={level} out of range for "
+            f"{codec} ({lo}-{hi})"
+        )
+    return level
+
+
+def override_compression(codec: str):
+    return _override_env(_ENV_COMPRESSION, codec)
+
+
+def override_compression_level(level: int):
+    return _override_env(_ENV_COMPRESSION_LEVEL, str(level))
+
+
 _ENV_GCS_CHUNK = "TORCHSNAPSHOT_TPU_GCS_CHUNK_BYTES"
 
 
