@@ -144,10 +144,17 @@ def main() -> None:
         pending = Snapshot.async_take(os.path.join(root, "ckpt_async"), {"model": sd})
         stall_s = time.perf_counter() - t0
         log(f"async_take stall (steady-state): {stall_s:.3f}s (training may resume/donate here)")
+        from torchsnapshot_tpu import snapshot as snapshot_mod
+
+        stall_phases = {
+            k: round(v, 4) for k, v in snapshot_mod.LAST_TAKE_PHASES.items()
+        }
+        log(f"stall decomposition: {stall_phases}")
         t0 = time.perf_counter()
         pending.wait()
         drain_s = time.perf_counter() - t0
-        log(f"background drain (D2H + storage I/O): {drain_s:.2f}s")
+        drain_stats = {k: round(v, 2) for k, v in pending.drain_stats.items()}
+        log(f"background drain (D2H + storage I/O): {drain_s:.2f}s {drain_stats}")
 
         # ---- detail: sync take + naive torch.save-style, each on its own
         # DISJOINT slice of fresh device arrays. jax caches the host copy of
@@ -208,6 +215,8 @@ def main() -> None:
                         "async_stall_s": round(stall_s, 3),
                         "async_stall_cold_s": round(cold_stall_s, 3),
                         "background_drain_s": round(drain_s, 2),
+                        "stall_phases_s": stall_phases,
+                        "drain_stats_s": drain_stats,
                         "target_stall_s": 5.0,
                         "sync_take_gbps": round(sync_gb / sync_s, 3),
                         "naive_save_gbps": round(sub_gb / naive_s, 3),

@@ -294,3 +294,24 @@ def _divergent_codec_worker(rank, world_size, shared):
             assert "TORCHSNAPSHOT_TPU_COMPRESSION" in str(e)
         else:
             raise AssertionError("divergent codecs did not fail the take")
+
+
+def test_restore_without_zstandard_fails_fast_at_planning(tmp_path, monkeypatch) -> None:
+    """Restoring a zstd snapshot on a host lacking zstandard must raise an
+    actionable error at read planning, not ImportError mid-pipeline."""
+    path = str(tmp_path / "c")
+    with knobs.override_compression("zstd"):
+        Snapshot.take(path, {"s": StateDict(a=np.arange(64, dtype=np.float32))})
+
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_zstd(name, *args, **kwargs):
+        if name == "zstandard":
+            raise ImportError(name)
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_zstd)
+    with pytest.raises(RuntimeError, match="zstandard"):
+        Snapshot(path).restore({"s": StateDict(a=np.zeros(64, np.float32))})

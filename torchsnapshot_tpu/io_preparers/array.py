@@ -34,9 +34,11 @@ from ..serialization import (
     array_as_bytes_view,
     array_from_bytes,
     array_nbytes,
+    codec_for_raw_serializer,
     compress_payload,
     decode_raw_payload,
     dtype_to_string,
+    ensure_codec_available,
     is_raw_family,
     is_raw_serializable,
     raw_serializer_for_codec,
@@ -84,8 +86,9 @@ class ArrayBufferStager(BufferStager):
         # invalid ambient level would raise mid-drain).
         self.compression_level: Optional[int] = None
         if entry.serializer in (Serializer.RAW_ZSTD, Serializer.RAW_ZLIB):
-            codec = "zstd" if entry.serializer == Serializer.RAW_ZSTD else "zlib"
-            self.compression_level = knobs.get_compression_level(_codec=codec)
+            self.compression_level = knobs.get_compression_level(
+                _codec=codec_for_raw_serializer(entry.serializer)
+            )
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         arr = self.arr
@@ -265,6 +268,7 @@ class ArrayIOPreparer:
         buffer_size_limit_bytes: Optional[int] = None,
     ) -> List[ReadReq]:
         """Plan reads filling ``target`` (a writable host array)."""
+        ensure_codec_available(entry.serializer)
         if entry.serializer != Serializer.RAW:
             # Pickled and compressed payloads have no raw byte layout on
             # storage: read the whole object (never budget-chunked), ranged

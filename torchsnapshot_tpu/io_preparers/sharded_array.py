@@ -33,6 +33,7 @@ from ..serialization import (
     Serializer,
     array_from_bytes,
     decode_raw_payload,
+    ensure_codec_available,
     is_raw_family,
     string_to_dtype,
 )
@@ -267,6 +268,7 @@ class ShardedArrayIOPreparer:
         """
         read_reqs: List[ReadReq] = []
         for shard in entry.shards:
+            ensure_codec_available(shard.tensor.serializer)
             base = tuple(shard.tensor.byte_range) if shard.tensor.byte_range else None
             for sub_off, sub_sz, byte_range in _budgeted_pieces(
                 shard, buffer_size_limit_bytes

@@ -65,6 +65,31 @@ def raw_serializer_for_codec(codec: str) -> str:
     return Serializer.RAW
 
 
+def codec_for_raw_serializer(serializer: str) -> str:
+    """Inverse of :func:`raw_serializer_for_codec` (single owner of the
+    mapping in both directions)."""
+    if serializer == Serializer.RAW_ZSTD:
+        return "zstd"
+    if serializer == Serializer.RAW_ZLIB:
+        return "zlib"
+    return "none"
+
+
+def ensure_codec_available(serializer: str) -> None:
+    """Fail fast with an actionable error when an entry needs a codec this
+    host lacks — called at read *planning* time, so a restore on a box
+    without ``zstandard`` raises up front, not mid-pipeline in an executor
+    thread (symmetric with the take-side check in ``knobs.get_compression``)."""
+    if serializer == Serializer.RAW_ZSTD:
+        try:
+            import zstandard  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "this snapshot's entries are zstd-compressed; restoring "
+                "requires the 'zstandard' package"
+            ) from e
+
+
 def compress_payload(view, serializer: str, level: int) -> bytes:
     """Compress a raw byte view per ``serializer`` (RAW passes through)."""
     if serializer == Serializer.RAW_ZSTD:
