@@ -159,10 +159,15 @@ def test_jax_distributed_sharded_save_restore(tmp_path) -> None:
 # (tiny max-shard knob), so restore must scatter many saved pieces into each
 # differently-shaped target shard.
 _ELASTIC_SHAPES = {
-    "params/w": (16, 8),
-    "params/b": (8,),
-    "opt/mu": (16, 8),
-    "opt/nu": (16, 4),
+    # Dims divide every mesh-axis product their specs actually face,
+    # including the ODD worlds (3 procs -> (3,2)/(2,3) meshes): 24-sized
+    # dims face divisors up to 8 (combined ('dp','tp') at 4 procs), while
+    # 12-sized dims only ever face 1,2,3,4,6 — 12 is NOT divisible by 8,
+    # so never shard a 12-dim across the combined axis in 4-proc worlds.
+    "params/w": (24, 12),
+    "params/b": (12,),
+    "opt/mu": (24, 12),
+    "opt/nu": (24, 12),
 }
 
 
@@ -296,6 +301,16 @@ def test_elastic_reshard_4_to_2(tmp_path) -> None:
 
 def test_elastic_reshard_2_to_1(tmp_path) -> None:
     _run_elastic_reshard(tmp_path, nproc_save=2, nproc_restore=1)
+
+
+def test_elastic_reshard_2_to_3(tmp_path) -> None:
+    """Odd target world: 3 processes form a (3,2)-device save-incompatible
+    mesh; shard boundaries land at thirds that never existed at save time."""
+    _run_elastic_reshard(tmp_path, nproc_save=2, nproc_restore=3)
+
+
+def test_elastic_reshard_3_to_2(tmp_path) -> None:
+    _run_elastic_reshard(tmp_path, nproc_save=3, nproc_restore=2)
 
 
 def _worker_local_sharded_no_clobber(rank: int, world_size: int, shared: str) -> None:
