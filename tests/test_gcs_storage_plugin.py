@@ -116,9 +116,9 @@ def fake_gcs(monkeypatch):
     fail_reads: dict = {}
     _install_fake_gcs(monkeypatch, blobs, fail_reads)
     # Keep retry backoff out of the test's wall clock.
-    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
 
-    monkeypatch.setattr(gcs_mod, "_BASE_BACKOFF_S", 0.001)
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 0.001)
     return blobs, fail_reads
 
 

@@ -191,3 +191,249 @@ def test_absent_object_normalized_to_file_not_found(fake_s3) -> None:
         await plugin.close()
 
     _run(go())
+
+
+# ---------------------------------------------------------------------------
+# Multipart uploads with per-part retry (the S3 analogue of GCS resumable
+# cursor recovery: a transient fault re-sends at most one part).
+# ---------------------------------------------------------------------------
+
+def _install_fake_multipart_s3(monkeypatch, objects: dict, stats: dict, faults: dict):
+    """Fake client with multipart APIs; ``faults`` maps part numbers to a
+    list of exceptions raised on successive upload attempts of that part."""
+
+    class FakeClient:
+        def __init__(self):
+            self._mpu: dict = {}  # upload_id -> {part_number: bytes}
+
+        async def put_object(self, Bucket, Key, Body) -> None:
+            stats["puts"] = stats.get("puts", 0) + 1
+            objects[(Bucket, Key)] = bytes(Body)
+
+        async def create_multipart_upload(self, Bucket, Key):
+            upload_id = f"mpu-{len(self._mpu)}"
+            self._mpu[upload_id] = {}
+            stats["created"] = stats.get("created", 0) + 1
+            return {"UploadId": upload_id}
+
+        async def upload_part(self, Bucket, Key, PartNumber, UploadId, Body):
+            data = bytes(Body)
+            stats["part_bytes_sent"] = stats.get("part_bytes_sent", 0) + len(data)
+            pending = faults.get(PartNumber)
+            if pending:
+                raise pending.pop(0)
+            self._mpu[UploadId][PartNumber] = data
+            return {"ETag": f"etag-{PartNumber}"}
+
+        async def complete_multipart_upload(self, Bucket, Key, UploadId, MultipartUpload):
+            parts = self._mpu.pop(UploadId)
+            ordered = [parts[p["PartNumber"]] for p in MultipartUpload["Parts"]]
+            objects[(Bucket, Key)] = b"".join(ordered)
+            stats["completed"] = stats.get("completed", 0) + 1
+
+        async def abort_multipart_upload(self, Bucket, Key, UploadId):
+            self._mpu.pop(UploadId, None)
+            stats["aborted"] = stats.get("aborted", 0) + 1
+
+        async def get_object(self, Bucket, Key, **kwargs):
+            try:
+                data = objects[(Bucket, Key)]
+            except KeyError:
+                e = Exception(f"NoSuchKey: {Key}")
+                e.response = {"Error": {"Code": "NoSuchKey"}}
+                raise e from None
+            if "Range" in kwargs:
+                m = re.fullmatch(r"bytes=(\d+)-(\d+)", kwargs["Range"])
+                lo, hi_inclusive = int(m.group(1)), int(m.group(2))
+                data = data[lo : hi_inclusive + 1]
+
+            class _Stream:
+                async def __aenter__(self):
+                    return self
+
+                async def __aexit__(self, *exc):
+                    return False
+
+                async def read(self):
+                    return data
+
+            return {"Body": _Stream()}
+
+        async def delete_object(self, Bucket, Key) -> None:
+            objects.pop((Bucket, Key), None)
+
+    class FakeClientCtx:
+        async def __aenter__(self):
+            return FakeClient()
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class FakeSession:
+        def client(self, service):
+            return FakeClientCtx()
+
+    mod = types.ModuleType("aioboto3")
+    mod.Session = FakeSession
+    monkeypatch.setitem(sys.modules, "aioboto3", mod)
+
+
+@pytest.fixture
+def fake_multipart_s3(monkeypatch):
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
+
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 0.001)
+    objects: dict = {}
+    stats: dict = {}
+    faults: dict = {}
+    _install_fake_multipart_s3(monkeypatch, objects, stats, faults)
+    return objects, stats, faults
+
+
+def test_multipart_upload_with_per_part_faults(fake_multipart_s3) -> None:
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, faults = fake_multipart_s3
+    payload = bytes(range(256)) * 40  # 10 KiB -> 10 parts of 1 KiB
+    faults[2] = [ConnectionError("reset")]
+    faults[7] = [TimeoutError("stall"), ConnectionError("reset again")]
+    n_fault_attempts = 3
+
+    plugin = S3StoragePlugin(root="bucket/pre")
+    with knobs.override_s3_chunk_bytes(1024):
+        _run(plugin.write(WriteIO(path="big", buf=memoryview(payload))))
+    _run(plugin.close())
+    assert objects[("bucket", "pre/big")] == payload
+    assert stats["completed"] == 1 and stats.get("aborted", 0) == 0
+    # <= one part re-sent per fault attempt.
+    assert stats["part_bytes_sent"] == len(payload) + n_fault_attempts * 1024
+
+
+def test_multipart_upload_aborts_on_permanent_failure(fake_multipart_s3) -> None:
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, faults = fake_multipart_s3
+    denied = Exception("AccessDenied")
+    denied.response = {"Error": {"Code": "AccessDenied"}}
+    faults[3] = [denied]
+
+    plugin = S3StoragePlugin(root="bucket")
+    with knobs.override_s3_chunk_bytes(1024):
+        with pytest.raises(Exception, match="AccessDenied"):
+            _run(plugin.write(WriteIO(path="nope", buf=bytes(4096))))
+    _run(plugin.close())
+    assert ("bucket", "nope") not in objects
+    assert stats.get("aborted", 0) == 1  # no orphaned parts left behind
+
+
+def test_small_objects_keep_single_put(fake_multipart_s3) -> None:
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, _ = fake_multipart_s3
+    plugin = S3StoragePlugin(root="bucket")
+    with knobs.override_s3_chunk_bytes(1024):
+        _run(plugin.write(WriteIO(path="small", buf=b"tiny")))
+    _run(plugin.close())
+    assert objects[("bucket", "small")] == b"tiny"
+    assert stats.get("puts") == 1 and "created" not in stats
+
+
+def test_transient_s3_codes_retried(fake_s3, monkeypatch) -> None:
+    """Structured throttling codes retry; the op eventually succeeds."""
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 0.001)
+    plugin = S3StoragePlugin(root="bucket")
+
+    async def go():
+        client = await plugin._get_client()
+        real_put = client.put_object
+        remaining = {"n": 2}
+
+        async def flaky_put(**kw):
+            if remaining["n"]:
+                remaining["n"] -= 1
+                e = Exception("SlowDown")
+                e.response = {"Error": {"Code": "SlowDown"}}
+                raise e
+            return await real_put(**kw)
+
+        client.put_object = flaky_put
+        await plugin.write(WriteIO(path="k", buf=b"v"))
+        await plugin.close()
+
+    _run(go())
+    assert fake_s3[("bucket", "k")] == b"v"
+
+
+def test_botocore_network_errors_classified_transient(monkeypatch) -> None:
+    """Real aiobotocore network faults are botocore exception types, not the
+    Python builtins — they must classify as transient."""
+    gexc = types.ModuleType("botocore.exceptions")
+
+    class FakeBotoConnErr(Exception):
+        pass
+
+    class FakeHTTPClientError(Exception):
+        pass
+
+    gexc.ConnectionError = FakeBotoConnErr
+    gexc.HTTPClientError = FakeHTTPClientError
+    boto_mod = types.ModuleType("botocore")
+    boto_mod.exceptions = gexc
+    monkeypatch.setitem(sys.modules, "botocore", boto_mod)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", gexc)
+    from torchsnapshot_tpu.storage_plugins.s3 import _is_transient
+
+    assert _is_transient(FakeBotoConnErr("endpoint reset"))
+    assert _is_transient(FakeHTTPClientError("read timeout"))
+    assert not _is_transient(ValueError("permanent"))
+    denied = Exception("AccessDenied")
+    denied.response = {"Error": {"Code": "AccessDenied"}}
+    assert not _is_transient(denied)
+
+
+def test_mid_stream_read_fault_retried(fake_s3, monkeypatch) -> None:
+    """A connection reset DURING the body download retries the whole read,
+    not just the initial request."""
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 0.001)
+    plugin = S3StoragePlugin(root="bucket")
+
+    async def go():
+        await plugin.write(WriteIO(path="k", buf=b"payload"))
+        client = await plugin._get_client()
+        real_get = client.get_object
+        remaining = {"n": 2}
+
+        async def get_with_flaky_stream(**kw):
+            resp = await real_get(**kw)
+            if remaining["n"]:
+                remaining["n"] -= 1
+
+                class _Dying:
+                    async def __aenter__(self):
+                        return self
+
+                    async def __aexit__(self, *exc):
+                        return False
+
+                    async def read(self):
+                        raise ConnectionError("reset mid-stream")
+
+                return {"Body": _Dying()}
+            return resp
+
+        client.get_object = get_with_flaky_stream
+        rio = ReadIO(path="k")
+        await plugin.read(rio)
+        await plugin.close()
+        return rio.buf.getvalue()
+
+    assert _run(go()) == b"payload"

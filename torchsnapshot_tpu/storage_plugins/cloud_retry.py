@@ -1,0 +1,79 @@
+"""Shared transient-retry machinery for cloud storage plugins (GCS, S3).
+
+One home for the backoff policy and the collective-progress window so
+classification fixes and window-semantics changes land in one place, and
+neither plugin reaches into the other's private names.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+
+logger = logging.getLogger(__name__)
+
+BASE_BACKOFF_S = 0.5
+MAX_BACKOFF_S = 8.0
+PROGRESS_WINDOW_S = 120.0
+
+
+class CollectiveProgress:
+    """Shared retry deadline across all concurrent ops on one plugin
+    (reference ``gcs.py:214-270``).
+
+    Under congestion every operation slows down together; a fixed per-op
+    attempt cap aborts requests that are merely queued behind slow peers.
+    Instead, the deadline is refreshed whenever any operation *starts* or
+    *succeeds*, and an op only gives up on a transient error once the plugin
+    as a whole has neither started nor completed anything for ``window_s`` —
+    so a total outage expires 120 s after the last activity, while an idle
+    gap between checkpoints can never pre-expire the first write's retries.
+    """
+
+    def __init__(self, window_s: float = PROGRESS_WINDOW_S) -> None:
+        self.window_s = window_s
+        self._last = time.monotonic()
+
+    def note_progress(self) -> None:
+        self._last = time.monotonic()
+
+    def out_of_time(self) -> bool:
+        return time.monotonic() - self._last > self.window_s
+
+
+def backoff_s(attempt: int) -> float:
+    """Jittered exponential backoff shared by every retry path. Reads the
+    module constants at call time so tests can shrink them."""
+    return min(MAX_BACKOFF_S, BASE_BACKOFF_S * (2**attempt)) * (
+        0.5 + random.random()
+    )
+
+
+async def retry_transient(run, is_transient, progress: CollectiveProgress, label: str):
+    """``await run()`` with transient retry under the collective-progress
+    window: op start/success count as activity; a total outage expires the
+    window, congestion that still makes progress does not."""
+    attempt = 0
+    progress.note_progress()
+    while True:
+        try:
+            result = await run()
+        except Exception as e:  # noqa: BLE001 - classified by the caller
+            if not is_transient(e) or progress.out_of_time():
+                raise
+            attempt += 1
+            backoff = backoff_s(attempt)
+            logger.warning(
+                "Transient %s error (attempt %d, retrying in %.1fs while "
+                "the plugin makes collective progress): %s",
+                label,
+                attempt,
+                backoff,
+                e,
+            )
+            await asyncio.sleep(backoff)
+        else:
+            progress.note_progress()
+            return result

@@ -18,49 +18,21 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import random
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..memoryview_stream import MemoryviewStream
 from ..utils import knobs
+from .cloud_retry import CollectiveProgress, backoff_s, retry_transient
 
 logger = logging.getLogger(__name__)
 
 _IO_THREADS = 8
-_BASE_BACKOFF_S = 0.5
-_MAX_BACKOFF_S = 8.0
-_PROGRESS_WINDOW_S = 120.0
 # Consecutive transmits of ONE resumable chunk with no cursor advance before
 # the upload aborts (~2.5 min at max backoff). Needed because successful
 # cursor-recovery calls keep the collective-progress window open forever.
 _MAX_STALLED_CHUNK_RETRIES = 12
-
-
-class _CollectiveProgress:
-    """Shared retry deadline across all concurrent ops on one plugin
-    (reference ``gcs.py:214-270``).
-
-    Under congestion every operation slows down together; a fixed per-op
-    attempt cap aborts requests that are merely queued behind slow peers.
-    Instead, the deadline is refreshed whenever any operation *starts* or
-    *succeeds*, and an op only gives up on a transient error once the plugin
-    as a whole has neither started nor completed anything for ``window_s`` —
-    so a total outage expires 120 s after the last activity, while an idle
-    gap between checkpoints can never pre-expire the first write's retries.
-    """
-
-    def __init__(self, window_s: float = _PROGRESS_WINDOW_S) -> None:
-        self.window_s = window_s
-        self._last = time.monotonic()
-
-    def note_progress(self) -> None:
-        self._last = time.monotonic()
-
-    def out_of_time(self) -> bool:
-        return time.monotonic() - self._last > self.window_s
 
 
 class GCSStoragePlugin(StoragePlugin):
@@ -76,7 +48,7 @@ class GCSStoragePlugin(StoragePlugin):
         self._client = gcs.Client()
         self._bucket = self._client.bucket(bucket_name)
         self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
-        self._progress = _CollectiveProgress()
+        self._progress = CollectiveProgress()
         # One authorized HTTP session shared by all resumable uploads on
         # this plugin (connection reuse; closed with the plugin). Lazy: most
         # snapshots never exceed the chunk threshold.
@@ -100,27 +72,12 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def _retrying(self, fn) -> object:
         loop = asyncio.get_event_loop()
-        attempt = 0
-        self._progress.note_progress()  # op start counts as activity
-        while True:
-            try:
-                result = await loop.run_in_executor(self._executor, fn)
-            except Exception as e:  # noqa: BLE001 - classified below
-                if not _is_transient(e) or self._progress.out_of_time():
-                    raise
-                attempt += 1
-                backoff = _backoff_s(attempt)
-                logger.warning(
-                    "Transient GCS error (attempt %d, retrying in %.1fs while "
-                    "the plugin makes collective progress): %s",
-                    attempt,
-                    backoff,
-                    e,
-                )
-                await asyncio.sleep(backoff)
-            else:
-                self._progress.note_progress()
-                return result
+        return await retry_transient(
+            lambda: loop.run_in_executor(self._executor, fn),
+            _is_transient,
+            self._progress,
+            "GCS",
+        )
 
     async def write(self, write_io: WriteIO) -> None:
         mv = memoryview(write_io.buf)
@@ -176,7 +133,7 @@ class GCSStoragePlugin(StoragePlugin):
                 if not _is_transient(e) or self._progress.out_of_time():
                     raise
                 attempt += 1
-                backoff = _backoff_s(attempt)
+                backoff = backoff_s(attempt)
                 logger.warning(
                     "Transient GCS error mid-upload of %s at byte %d "
                     "(attempt %d, recovering cursor and retrying in %.1fs): %s",
@@ -356,13 +313,6 @@ class _GoogleResumableSession:
 def _response_status(e: Exception):
     """HTTP status attached to an SDK error (e.g. InvalidResponse), or None."""
     return getattr(getattr(e, "response", None), "status_code", None)
-
-
-def _backoff_s(attempt: int) -> float:
-    """Jittered exponential backoff shared by every retry path."""
-    return min(_MAX_BACKOFF_S, _BASE_BACKOFF_S * (2**attempt)) * (
-        0.5 + random.random()
-    )
 
 
 def _make_authorized_session(client):

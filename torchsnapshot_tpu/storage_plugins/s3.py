@@ -4,17 +4,33 @@ put/get_object with ranged reads via the HTTP ``Range`` header (whose end is
 inclusive — same off-by-one the reference fixes at ``s3.py:53-60``), and
 zero-copy streaming of staged memoryviews.
 
+Beyond the reference: transient errors retry under the same
+collective-progress window as the GCS plugin, and objects above the chunk
+threshold upload via S3 multipart — each part retried individually, so a
+mid-transfer fault re-sends at most one part instead of the whole object
+(the S3 analogue of GCS resumable-upload cursor recovery; parts are
+idempotent by PartNumber). Failed multipart uploads are aborted so orphaned
+parts don't accrue storage.
+
 The SDK (aioboto3/aiobotocore) import is lazy and gated with a clear error.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..utils import knobs
+from .cloud_retry import CollectiveProgress, retry_transient
 
 logger = logging.getLogger(__name__)
+
+# Concurrent in-flight parts per multipart upload: parts are independent
+# slices of one already-staged buffer, so concurrency costs no memory and
+# hides per-part round-trip latency on large objects.
+_MULTIPART_CONCURRENCY = 8
 
 
 class S3StoragePlugin(StoragePlugin):
@@ -30,6 +46,7 @@ class S3StoragePlugin(StoragePlugin):
         self._session = aioboto3.Session()
         self._client_ctx = None
         self._client = None
+        self._progress = CollectiveProgress()
 
     async def _get_client(self):
         if self._client is None:
@@ -40,16 +57,104 @@ class S3StoragePlugin(StoragePlugin):
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
-    async def write(self, write_io: WriteIO) -> None:
-        client = await self._get_client()
-        await client.put_object(
-            Bucket=self.bucket,
-            Key=self._key(write_io.path),
-            # bytes-like staged buffers (incl. memoryviews) stream without a
-            # copy; copying a multi-GB shard here would blow the scheduler's
-            # memory budget accounting.
-            Body=write_io.buf,
+    async def _retrying(self, coro_factory):
+        return await retry_transient(
+            coro_factory, _is_transient, self._progress, "S3"
         )
+
+    async def write(self, write_io: WriteIO) -> None:
+        mv = memoryview(write_io.buf)
+        if mv.nbytes > knobs.get_s3_chunk_bytes():
+            await self._upload_multipart(write_io.path, mv)
+            return
+        client = await self._get_client()
+
+        def put():
+            return client.put_object(
+                Bucket=self.bucket,
+                Key=self._key(write_io.path),
+                # bytes-like staged buffers (incl. memoryviews) stream
+                # without a copy; copying a multi-GB shard here would blow
+                # the scheduler's memory budget accounting.
+                Body=write_io.buf,
+            )
+
+        await self._retrying(put)
+
+    async def _upload_multipart(self, path: str, mv: memoryview) -> None:
+        """Chunked upload with per-part retry: a transient fault re-sends at
+        most the interrupted part. Aborts the upload on permanent failure so
+        S3 doesn't bill for orphaned parts forever."""
+        client = await self._get_client()
+        key = self._key(path)
+        chunk = knobs.get_s3_chunk_bytes()
+        created = await self._retrying(
+            lambda: client.create_multipart_upload(Bucket=self.bucket, Key=key)
+        )
+        upload_id = created["UploadId"]
+        try:
+            # Parts are order-independent on the wire; bounded concurrency
+            # hides per-part round-trip latency. gather preserves input
+            # order, so the completed Parts list stays sorted by number.
+            sem = asyncio.Semaphore(_MULTIPART_CONCURRENCY)
+
+            async def send_one(number: int, body) -> dict:
+                async with sem:
+                    resp = await self._retrying(
+                        lambda: client.upload_part(
+                            Bucket=self.bucket,
+                            Key=key,
+                            PartNumber=number,
+                            UploadId=upload_id,
+                            Body=body,
+                        )
+                    )
+                return {"PartNumber": number, "ETag": resp["ETag"]}
+
+            tasks = [
+                asyncio.ensure_future(send_one(number, mv[offset : offset + chunk]))
+                for number, offset in enumerate(range(0, mv.nbytes, chunk), start=1)
+            ]
+            try:
+                parts = await asyncio.gather(*tasks)
+            except BaseException:
+                # Quiesce siblings BEFORE aborting: parts uploaded
+                # concurrently with an abort can still land (and bill)
+                # per AWS semantics, and abandoned tasks would surface as
+                # never-retrieved exceptions.
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+            await self._retrying(
+                lambda: client.complete_multipart_upload(
+                    Bucket=self.bucket,
+                    Key=key,
+                    UploadId=upload_id,
+                    MultipartUpload={"Parts": list(parts)},
+                )
+            )
+        except BaseException:
+            try:
+                # The abort gets the same transient-retry treatment as any
+                # other op: the failure context is often congestion, and a
+                # swallowed abort orphans every uploaded part until a
+                # lifecycle rule cleans it.
+                await self._retrying(
+                    lambda: client.abort_multipart_upload(
+                        Bucket=self.bucket, Key=key, UploadId=upload_id
+                    )
+                )
+            except Exception:
+                logger.warning(
+                    "Failed to abort multipart upload %s for %s; orphaned "
+                    "parts may accrue storage until a bucket lifecycle rule "
+                    "cleans them",
+                    upload_id,
+                    key,
+                    exc_info=True,
+                )
+            raise
 
     async def read(self, read_io: ReadIO) -> None:
         client = await self._get_client()
@@ -58,16 +163,23 @@ class S3StoragePlugin(StoragePlugin):
             begin, end = read_io.byte_range
             # HTTP Range end is inclusive.
             kwargs["Range"] = f"bytes={begin}-{end - 1}"
-        try:
+        async def fetch() -> bytes:
+            # The body download is INSIDE the retried callable: a connection
+            # reset halfway through the stream is just as transient as one
+            # during the request itself.
             resp = await client.get_object(
                 Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
             )
+            async with resp["Body"] as stream:
+                return await stream.read()
+
+        try:
+            data = await self._retrying(fetch)
         except Exception as e:
             if _is_no_such_key(e):
                 raise FileNotFoundError(read_io.path) from e
             raise
-        async with resp["Body"] as stream:
-            read_io.buf.write(await stream.read())
+        read_io.buf.write(data)
 
     async def delete(self, path: str) -> None:
         # S3 DeleteObject is idempotent (204 for absent keys) — the allowed
@@ -75,7 +187,9 @@ class S3StoragePlugin(StoragePlugin):
         # contract. No HEAD probe: it would double round-trips and break
         # under delete-only IAM policies (HeadObject needs read permission).
         client = await self._get_client()
-        await client.delete_object(Bucket=self.bucket, Key=self._key(path))
+        await self._retrying(
+            lambda: client.delete_object(Bucket=self.bucket, Key=self._key(path))
+        )
 
     async def link_in(self, src_abs_path: str, path: str) -> bool:
         """Server-side CopyObject from a base snapshot (incremental takes):
@@ -120,3 +234,47 @@ def _is_no_such_key(e: Exception) -> bool:
         code = code.get("Error", {}).get("Code")
         return code in ("NoSuchKey", "NotFound", "404")
     return False
+
+
+_TRANSIENT_S3_CODES = frozenset(
+    {
+        "SlowDown",
+        "InternalError",
+        "RequestTimeout",
+        "ServiceUnavailable",
+        "Throttling",
+        "ThrottlingException",
+        "RequestLimitExceeded",
+        "500",
+        "502",
+        "503",
+        "504",
+    }
+)
+
+
+def _is_transient(e: Exception) -> bool:
+    resp = getattr(e, "response", None)
+    if isinstance(resp, dict):
+        code = resp.get("Error", {}).get("Code")
+        if code in _TRANSIENT_S3_CODES:
+            return True
+        # Absence is never transient; other structured errors (access
+        # denied, validation) are permanent too.
+        return False
+    try:
+        # Real network faults from aiobotocore are botocore exception types
+        # (EndpointConnectionError/ConnectTimeoutError subclass botocore's
+        # ConnectionError; ReadTimeoutError subclasses HTTPClientError) —
+        # NOT the Python builtins, which the fallback below covers for
+        # non-boto transports and fakes.
+        from botocore.exceptions import (  # type: ignore[import-not-found]
+            ConnectionError as BotoConnectionError,
+            HTTPClientError,
+        )
+
+        if isinstance(e, (BotoConnectionError, HTTPClientError)):
+            return True
+    except ImportError:
+        pass
+    return isinstance(e, (ConnectionError, TimeoutError))

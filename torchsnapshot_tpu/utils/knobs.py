@@ -209,6 +209,24 @@ def override_compression_level(level: int):
     return _override_env(_ENV_COMPRESSION_LEVEL, str(level))
 
 
+_ENV_S3_CHUNK = "TORCHSNAPSHOT_TPU_S3_CHUNK_BYTES"
+
+
+def get_s3_chunk_bytes() -> int:
+    """Part size for S3 multipart uploads (default 100 MB).
+
+    Objects larger than one part upload multipart with per-part retry (a
+    fault re-sends at most one part); smaller ones use one PUT. Real S3
+    requires parts of at least 5 MiB (except the last) — values below that
+    are only meaningful with fake backends in tests.
+    """
+    return max(1, _get_int(_ENV_S3_CHUNK, 100 * 1024 * 1024))
+
+
+def override_s3_chunk_bytes(value: int):
+    return _override_env(_ENV_S3_CHUNK, str(value))
+
+
 _ENV_GCS_CHUNK = "TORCHSNAPSHOT_TPU_GCS_CHUNK_BYTES"
 
 
