@@ -315,3 +315,28 @@ def test_restore_without_zstandard_fails_fast_at_planning(tmp_path, monkeypatch)
     monkeypatch.setattr(builtins, "__import__", no_zstd)
     with pytest.raises(RuntimeError, match="zstandard"):
         Snapshot(path).restore({"s": StateDict(a=np.zeros(64, np.float32))})
+
+
+def test_compressed_sharded_reshard(tmp_path) -> None:
+    """Elasticity composes with compression: a compressed sharded snapshot
+    restores into different layouts (the two flagship features together).
+    Shard subdivision on save is forced so restore scatters many compressed
+    pieces per target shard."""
+    mesh42 = Mesh(np.array(jax.devices()).reshape(4, 2), ("a", "b"))
+    mesh8 = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    host = np.random.default_rng(3).standard_normal((16, 16)).astype(np.float32)
+    arr = jax.device_put(jnp.asarray(host), NamedSharding(mesh42, P("a", "b")))
+    path = str(tmp_path / "c")
+    with knobs.override_compression("zstd"), knobs.override_max_shard_size_bytes(96):
+        Snapshot.take(path, {"s": StateDict(x=arr)})
+    entry = Snapshot(path).get_manifest()["0/s/x"]
+    assert all(s.tensor.serializer == Serializer.RAW_ZSTD for s in entry.shards)
+    assert len(entry.shards) > 8  # subdivision happened
+    for spec, mesh in [(P(None, "x"), mesh8), (P("b", "a"), mesh42), (P(), mesh8)]:
+        live = jax.device_put(
+            jnp.zeros((16, 16), jnp.float32), NamedSharding(mesh, spec)
+        )
+        tgt = StateDict(x=live)
+        Snapshot(path).restore({"s": tgt})
+        got = np.asarray(tgt["x"])
+        assert got.view(np.uint8).tobytes() == host.view(np.uint8).tobytes(), spec
