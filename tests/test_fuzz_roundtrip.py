@@ -48,7 +48,7 @@ def _random_value(rng: np.random.Generator, depth: int):
     return [_random_value(rng, depth + 1) for _ in range(rng.integers(1, 4))]
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(12))  # 12 = two passes over the 2x3 batching-x-codec grid
 def test_random_state_roundtrip(tmp_path, seed) -> None:
     rng = np.random.default_rng(seed)
     sd = StateDict(
@@ -58,13 +58,17 @@ def test_random_state_roundtrip(tmp_path, seed) -> None:
     # otherwise corrupt both sides of the comparison identically.
     expected = copy.deepcopy(dict(sd))
     path = str(tmp_path / "ckpt")
-    # Exercise chunking/batching paths on alternate seeds.
-    if seed % 2:
-        ctx_batch = knobs.override_batching_enabled(True)
-        ctx_chunk = knobs.override_max_chunk_size_bytes(64)
-        with ctx_batch, ctx_chunk:
-            Snapshot.take(path, {"s": sd})
-    else:
+    # Exercise chunking/batching on alternate seeds and rotate the
+    # compression codec, so every pairwise feature composition gets fuzzed.
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        if seed % 2:
+            stack.enter_context(knobs.override_batching_enabled(True))
+            stack.enter_context(knobs.override_max_chunk_size_bytes(64))
+        codec = ("none", "zstd", "zlib")[seed % 3]
+        if codec != "none":
+            stack.enter_context(knobs.override_compression(codec))
         Snapshot.take(path, {"s": sd})
     out = StateDict()
     Snapshot(path).restore({"s": out})
