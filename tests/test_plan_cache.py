@@ -1,0 +1,202 @@
+"""Cross-take plan cache: a second take of an identical app-state structure
+must issue NO O(world) collectives — no key/partition/hostname all_gathers,
+no per-key barriers — only the constant-cost preflight round, the manifest
+delta gather, and the commit barriers (VERDICT round 2, next-round item 1).
+
+Correctness under the cache is covered from several angles: changed primitive
+values must flow through the delta gather into the committed manifest,
+replicated entries must still be written exactly once under the cached
+partition assignment, and any structure change must force a miss (and a
+correct full-path take).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.test_utils import run_with_processes
+
+pytestmark = pytest.mark.multiprocess
+
+
+def _counting_coordinator():
+    """Wrap the process coordinator's collectives with call counters."""
+    from torchsnapshot_tpu.parallel.coordinator import get_coordinator
+
+    coord = get_coordinator()
+    counts = {"all_gather": 0, "barrier": 0, "gather": 0, "broadcast": 0}
+    orig = {
+        "all_gather": coord.all_gather_object,
+        "barrier": coord.barrier,
+        "gather": coord.gather_object,
+        "broadcast": coord.broadcast_object,
+    }
+
+    def wrap(name):
+        def inner(*args, **kwargs):
+            counts[name] += 1
+            return orig[name](*args, **kwargs)
+
+        return inner
+
+    coord.all_gather_object = wrap("all_gather")
+    coord.barrier = wrap("barrier")
+    coord.gather_object = wrap("gather")
+    coord.broadcast_object = wrap("broadcast")
+    return coord, counts
+
+
+def _worker_steady_state_no_allgathers(rank, world_size, shared):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    coord, counts = _counting_coordinator()
+
+    app = {
+        "train": StateDict(
+            w=np.arange(16, dtype=np.float32) + rank, step=0
+        ),
+        "repl": StateDict(table=np.arange(6, dtype=np.int64)),
+    }
+    Snapshot.take(os.path.join(shared, "c0"), app, replicated=["repl/*"])
+    first = dict(counts)
+    # First take pays the full coordination bill (preflight + partition
+    # all_gather + hostname all_gather + manifest gather + barriers).
+    assert first["all_gather"] >= 1, first
+
+    for k in counts:
+        counts[k] = 0
+    app["train"]["step"] = 7
+    Snapshot.take(os.path.join(shared, "c1"), app, replicated=["repl/*"])
+    second = dict(counts)
+
+    # The VERDICT done-criterion: no key-gather/partition/hostname
+    # all_gathers and no per-key barriers on a steady-state take.
+    assert second["all_gather"] == 0, second
+    assert second["barrier"] == 2, second  # data-done + commit-visible only
+    assert second["gather"] == 2, second  # preflight + manifest delta
+    assert second["broadcast"] == 1, second  # preflight decision
+
+    # The changed primitive must have flowed through the delta gather into
+    # the committed manifest...
+    snap = Snapshot(os.path.join(shared, "c1"))
+    manifest = snap.get_manifest()
+    assert manifest[f"{rank}/train/step"].get_value() == 7
+    # ...and the cached partition assignment must still write replicated
+    # data exactly once, to the rank-less replicated/ namespace.
+    locations = {
+        e.location
+        for k, e in manifest.items()
+        if getattr(e, "replicated", False) and hasattr(e, "location")
+    }
+    assert locations == {"replicated/repl/table"}, locations
+
+    tgt = {
+        "train": StateDict(w=np.zeros(16, dtype=np.float32), step=-1),
+        "repl": StateDict(table=np.zeros(6, dtype=np.int64)),
+    }
+    snap.restore(tgt)
+    assert tgt["train"]["step"] == 7
+    assert np.array_equal(
+        tgt["train"]["w"], np.arange(16, dtype=np.float32) + rank
+    )
+    assert np.array_equal(tgt["repl"]["table"], np.arange(6, dtype=np.int64))
+
+
+def test_steady_state_take_issues_no_allgathers(tmp_path) -> None:
+    run_with_processes(
+        _worker_steady_state_no_allgathers, nproc=2, args=(str(tmp_path),)
+    )
+
+
+def _worker_structure_change_forces_miss(rank, world_size, shared):
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    coord, counts = _counting_coordinator()
+
+    app = {"s": StateDict(w=np.arange(8, dtype=np.float32))}
+    Snapshot.take(os.path.join(shared, "c0"), app)
+    for k in counts:
+        counts[k] = 0
+    # Same logical paths, different shape: the fingerprint must miss and the
+    # full (all_gather-bearing) path must run.
+    app2 = {"s": StateDict(w=np.arange(12, dtype=np.float32))}
+    Snapshot.take(os.path.join(shared, "c1"), app2)
+    assert counts["all_gather"] >= 1, counts
+
+    tgt = {"s": StateDict(w=np.zeros(12, dtype=np.float32))}
+    Snapshot(os.path.join(shared, "c1")).restore(tgt)
+    assert np.array_equal(tgt["s"]["w"], np.arange(12, dtype=np.float32))
+
+
+def test_structure_change_forces_miss(tmp_path) -> None:
+    run_with_processes(
+        _worker_structure_change_forces_miss, nproc=2, args=(str(tmp_path),)
+    )
+
+
+def _worker_knob_disables_cache(rank, world_size, shared):
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs
+
+    coord, counts = _counting_coordinator()
+    with knobs.override_plan_cache(False):
+        app = {"s": StateDict(w=np.full((4,), rank, dtype=np.float32))}
+        Snapshot.take(os.path.join(shared, "c0"), app)
+        for k in counts:
+            counts[k] = 0
+        Snapshot.take(os.path.join(shared, "c1"), app)
+        # Cache off: the partition/hostname all_gathers run every take.
+        assert counts["all_gather"] >= 1, counts
+    tgt = {"s": StateDict(w=np.zeros(4, dtype=np.float32))}
+    Snapshot(os.path.join(shared, "c1")).restore(tgt)
+    assert np.array_equal(tgt["s"]["w"], np.full((4,), rank, dtype=np.float32))
+
+
+def test_knob_disables_cache(tmp_path) -> None:
+    run_with_processes(
+        _worker_knob_disables_cache, nproc=2, args=(str(tmp_path),)
+    )
+
+
+def _worker_sharded_cache_hit_bit_exact(rank, world_size, shared):
+    """Sharded GSPMD arrays under the cache: the second take must hit and
+    still commit shard layouts + fresh values bit-exactly."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    coord, counts = _counting_coordinator()
+    devices = np.array(jax.devices()).reshape(world_size * 2)
+    mesh = Mesh(devices, ("x",))
+    base = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+
+    def make(data):
+        return jax.make_array_from_callback(
+            (16, 4), NamedSharding(mesh, P("x")), lambda idx: data[idx]
+        )
+
+    Snapshot.take(os.path.join(shared, "c0"), {"s": StateDict(x=make(base))})
+    for k in counts:
+        counts[k] = 0
+    bumped = base + 100.0
+    Snapshot.take(os.path.join(shared, "c1"), {"s": StateDict(x=make(bumped))})
+    assert counts["all_gather"] == 0, counts
+
+    tgt = StateDict(x=make(np.zeros_like(base)))
+    Snapshot(os.path.join(shared, "c1")).restore({"s": tgt})
+    for shard in tgt["x"].addressable_shards:
+        got = np.asarray(shard.data)
+        assert np.array_equal(
+            got.view(np.uint8), bumped[shard.index].view(np.uint8)
+        )
+
+
+def test_sharded_cache_hit_bit_exact(tmp_path) -> None:
+    run_with_processes(
+        _worker_sharded_cache_hit_bit_exact,
+        nproc=2,
+        init_jax_distributed=True,
+        args=(str(tmp_path),),
+    )

@@ -1,22 +1,24 @@
 """Replication-glob semantics (reference ``tests/test_replication_glob.py`` and
 ``tests/test_ddp_replication_glob.py``): glob -> replicated-path tables, and
-rank-asymmetric globs being dropped during coalescing."""
+rank-asymmetric globs being dropped during coalescing — which now happens in
+the take preflight round (``take_plan.preflight``)."""
 
 import logging
 
 import pytest
 
 from torchsnapshot_tpu.snapshot import Snapshot
+from torchsnapshot_tpu.take_plan import preflight
 
 
 class _FakeCoordinator:
-    """Minimal coordinator: each 'rank' contributes one element per gather."""
+    """Minimal coordinator for preflight: the rank-0 view (``gather_object``
+    hands back the canned per-rank payload list; broadcast echoes)."""
 
-    def __init__(self, rank: int, world_size: int, gathered_by_call):
+    def __init__(self, rank: int, world_size: int, gathered):
         self._rank = rank
         self._world = world_size
-        # list of lists: consecutive all_gather_object results to hand out
-        self._gathered = list(gathered_by_call)
+        self._gathered = gathered  # rank 0's gather result, or None
 
     def get_rank(self) -> int:
         return self._rank
@@ -24,8 +26,18 @@ class _FakeCoordinator:
     def get_world_size(self) -> int:
         return self._world
 
-    def all_gather_object(self, obj):
-        return self._gathered.pop(0)
+    def gather_object(self, obj, dst=0):
+        if self._rank != dst:
+            return None
+        # Substitute this rank's real payload into its slot so the canned
+        # fixture only has to specify the OTHER ranks' contributions.
+        out = list(self._gathered)
+        out[self._rank] = obj
+        return out
+
+    def broadcast_object(self, obj, src=0):
+        assert self._rank == src, "fake only models the deciding rank"
+        return obj
 
     def barrier(self) -> None:
         pass
@@ -64,12 +76,11 @@ def test_glob_matching_table(globs, expected) -> None:
 
 
 def test_single_process_passthrough() -> None:
-    coord = _FakeCoordinator(0, 1, [])
-    path, globs = Snapshot._coalesce_path_and_replicated(
-        "/tmp/snap", coord, ["b/**", "a/**", "a/**"]
-    )
-    assert path == "/tmp/snap"
-    assert globs == ["a/**", "b/**"]  # deduped + sorted
+    coord = _FakeCoordinator(0, 1, None)
+    pf = preflight(coord, "/tmp/snap", None, ["b/**", "a/**", "a/**"], None)
+    assert pf.path == "/tmp/snap"
+    assert pf.replicated_globs == ["a/**", "b/**"]  # deduped + sorted
+    assert not pf.hit  # world 1: nothing to cache
 
 
 def test_rank_asymmetric_globs_dropped(caplog) -> None:
@@ -79,35 +90,50 @@ def test_rank_asymmetric_globs_dropped(caplog) -> None:
         0,
         2,
         [
-            ["/tmp/snap", "/tmp/snap"],  # path gather
-            [["a/**", "b/**"], ["b/**", "c/**"]],  # glob gather
+            None,  # replaced by rank 0's own payload
+            ("/tmp/snap", None, ["b/**", "c/**"], None),
         ],
     )
     with caplog.at_level(logging.WARNING):
-        path, globs = Snapshot._coalesce_path_and_replicated(
-            "/tmp/snap", coord, ["a/**", "b/**"]
-        )
-    assert path == "/tmp/snap"
-    assert globs == ["b/**"]
+        pf = preflight(coord, "/tmp/snap", None, ["a/**", "b/**"], None)
+    assert pf.path == "/tmp/snap"
+    assert pf.replicated_globs == ["b/**"]
+    assert not pf.hit
     assert any("rank-asymmetric" in r.message.lower() for r in caplog.records)
 
 
 def test_rank_divergent_path_uses_rank0(caplog) -> None:
     coord = _FakeCoordinator(
-        1,
+        0,
         2,
         [
-            ["/snap/rank0", "/snap/rank1"],
-            [[], []],
+            None,
+            ("/snap/rank1", None, [], 5),
         ],
     )
     with caplog.at_level(logging.WARNING):
-        path, globs = Snapshot._coalesce_path_and_replicated(
-            "/snap/rank1", coord, []
-        )
-    assert path == "/snap/rank0"
-    assert globs == []
+        pf = preflight(coord, "/snap/rank0", None, [], 5)
+    assert pf.path == "/snap/rank0"
+    assert pf.replicated_globs == []
+    assert pf.hit  # every rank holds a plan stored by the same take (5)
     assert any("divergent" in r.message.lower() for r in caplog.records)
+
+
+def test_token_divergence_forces_miss() -> None:
+    # Ranks hold plans from DIFFERENT takes: their partition assignments
+    # may not compose, so the preflight must force a miss.
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 4)])
+    pf = preflight(coord, "/snap", None, [], 5)
+    assert not pf.hit
+
+
+def test_missing_cached_plan_forces_miss() -> None:
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], None)])
+    pf = preflight(coord, "/snap", None, [], 5)
+    assert not pf.hit
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 5)])
+    pf = preflight(coord, "/snap", None, [], None)
+    assert not pf.hit
 
 
 def test_glob_replicated_numpy_saved_under_replicated_prefix(tmp_path) -> None:

@@ -32,6 +32,46 @@ from typing import Any, Dict, List, Optional
 _DEFAULT_TIMEOUT_S = 300.0
 
 
+# ---------------------------------------------------------------------------
+# Store round-trip accounting. Every concrete store op is one logical
+# round-trip against the (rank-0-hosted) control-plane server, so these
+# counters are the raw material for the coordination-cost scaling model in
+# ``benchmarks/stall`` — they turn "the stall grows with world size" into
+# "this take issued N round-trips" and make the pod-scale stall a
+# calculation instead of a hope. Diagnostics only: per-process, reset by the
+# caller around the section being measured.
+# ---------------------------------------------------------------------------
+
+_OP_LOCK = threading.Lock()
+# (thread id, op) -> count: keyed per thread so a measurement window on the
+# main thread (e.g. an async_take stall) can exclude ops raced in by the
+# background commit thread's LinearBarrier polling.
+_OP_COUNTS: Dict[tuple, int] = {}
+
+
+def _count_op(op: str) -> None:
+    key = (threading.get_ident(), op)
+    with _OP_LOCK:
+        _OP_COUNTS[key] = _OP_COUNTS.get(key, 0) + 1
+
+
+def get_op_counts(current_thread_only: bool = False) -> Dict[str, int]:
+    """{op: count} since the last reset (set/get/try_get/add/delete)."""
+    me = threading.get_ident()
+    out: Dict[str, int] = {}
+    with _OP_LOCK:
+        for (tid, op), n in _OP_COUNTS.items():
+            if current_thread_only and tid != me:
+                continue
+            out[op] = out.get(op, 0) + n
+    return out
+
+
+def reset_op_counts() -> None:
+    with _OP_LOCK:
+        _OP_COUNTS.clear()
+
+
 class Store(abc.ABC):
     """Minimal KV contract needed by the coordinator and LinearBarrier."""
 
@@ -90,11 +130,13 @@ class LocalStore(Store):
         self._cond = threading.Condition()
 
     def set(self, key: str, value: bytes) -> None:
+        _count_op("set")
         with self._cond:
             self._data[key] = value
             self._cond.notify_all()
 
     def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        _count_op("get")
         deadline = time.monotonic() + timeout_s
         with self._cond:
             while key not in self._data:
@@ -104,16 +146,19 @@ class LocalStore(Store):
             return self._data[key]
 
     def try_get(self, key: str) -> Optional[bytes]:
+        _count_op("try_get")
         with self._cond:
             return self._data.get(key)
 
     def add(self, key: str, delta: int) -> int:
+        _count_op("add")
         with self._cond:
             self._counters[key] = self._counters.get(key, 0) + delta
             self._cond.notify_all()
             return self._counters[key]
 
     def delete(self, key: str) -> None:
+        _count_op("delete")
         with self._cond:
             self._data.pop(key, None)
             self._counters.pop(key, None)
@@ -151,9 +196,11 @@ class JaxCoordinationStore(Store):
         return f"{self._ns}/{key}"
 
     def set(self, key: str, value: bytes) -> None:
+        _count_op("set")
         self._client.key_value_set_bytes(self._k(key), bytes(value))
 
     def get(self, key: str, timeout_s: float = _DEFAULT_TIMEOUT_S) -> bytes:
+        _count_op("get")
         try:
             return bytes(
                 self._client.blocking_key_value_get_bytes(
@@ -172,6 +219,7 @@ class JaxCoordinationStore(Store):
             raise
 
     def try_get(self, key: str) -> Optional[bytes]:
+        _count_op("try_get")
         try:
             val = self._client.key_value_try_get_bytes(self._k(key))
         except Exception:
@@ -179,9 +227,11 @@ class JaxCoordinationStore(Store):
         return bytes(val) if val is not None else None
 
     def add(self, key: str, delta: int) -> int:
+        _count_op("add")
         return int(self._client.key_value_increment(self._k(key), delta))
 
     def delete(self, key: str) -> None:
+        _count_op("delete")
         try:
             self._client.key_value_delete(self._k(key))
         except Exception:
@@ -306,6 +356,7 @@ class TCPStore(Store):
         return sock
 
     def _call(self, op: str, key: str, arg: Any) -> Any:
+        _count_op(op)
         sock = self._sock()
         _send_msg(sock, (op, key, arg))
         status, val = _recv_msg(sock)

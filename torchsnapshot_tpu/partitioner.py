@@ -19,7 +19,7 @@ restore (reference ``consolidate_replicated_entries:259``).
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .io_types import WriteReq
 from .manifest import Entry, Manifest, is_replicated
@@ -37,10 +37,29 @@ def partition_write_reqs(
     coordinator: Coordinator,
 ) -> List[WriteReq]:
     """Return the subset of ``write_reqs`` this rank should execute."""
+    return partition_write_reqs_with_assignment(
+        manifest, write_reqs, coordinator
+    )[0]
+
+
+def partition_write_reqs_with_assignment(
+    manifest: Manifest,
+    write_reqs: List[WriteReq],
+    coordinator: Coordinator,
+    assignment: Optional[Dict[str, int]] = None,
+) -> Tuple[List[WriteReq], Dict[str, int]]:
+    """Like :func:`partition_write_reqs` but also returns the replicated
+    ``{storage_path: writer_rank}`` assignment so the plan cache can replay
+    it: with ``assignment`` supplied (a cache hit — identical structure,
+    shardings, and knobs, enforced by the take fingerprint), the load
+    all_gather is skipped entirely and the cached assignment is applied.
+    The codec-divergence check rides the gather, so it is only re-checked on
+    the gathering path; on a hit, codec equality is part of the fingerprint.
+    """
     world_size = coordinator.get_world_size()
     rank = coordinator.get_rank()
     if world_size == 1:
-        return write_reqs
+        return write_reqs, {}
 
     replicated_locations = set()
     for entry in manifest.values():
@@ -53,6 +72,26 @@ def partition_write_reqs(
 
     replicated_reqs = [r for r in write_reqs if r.path in replicated_locations]
     other_reqs = [r for r in write_reqs if r.path not in replicated_locations]
+
+    if assignment is not None:
+        # Loud, not silent: a replicated path the cached assignment doesn't
+        # know means the plan fingerprint failed to cover something that
+        # shapes storage paths — dropping the req would commit a manifest
+        # entry whose object no rank ever writes (checkpoint corruption
+        # discovered only at restore).
+        missing = [r.path for r in replicated_reqs if r.path not in assignment]
+        if missing:
+            raise RuntimeError(
+                "plan-cache assignment is missing replicated write paths "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}; this is a "
+                "bug in the take fingerprint — set "
+                "TORCHSNAPSHOT_TPU_PLAN_CACHE=0 to work around"
+            )
+        return (
+            other_reqs
+            + [r for r in replicated_reqs if assignment[r.path] == rank],
+            assignment,
+        )
 
     # Per-rank base load from non-replicated writes. The compression codec
     # rides the same gather: the serializer became env-dependent, and a rank
@@ -75,13 +114,16 @@ def partition_write_reqs(
         ((_estimate(r), r.path) for r in replicated_reqs),
         key=lambda t: (-t[0], t[1]),
     )
-    assignment: Dict[str, int] = {}
+    assignment = {}
     for size, path in items:
         target = min(range(world_size), key=lambda r: (loads[r], r))
         assignment[path] = target
         loads[target] += size
 
-    return other_reqs + [r for r in replicated_reqs if assignment[r.path] == rank]
+    return (
+        other_reqs + [r for r in replicated_reqs if assignment[r.path] == rank],
+        assignment,
+    )
 
 
 def consolidate_replicated_entries(global_manifest: Manifest) -> None:

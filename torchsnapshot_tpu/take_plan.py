@@ -1,0 +1,317 @@
+"""Take planning: the preflight collective round and the cross-take plan cache.
+
+Why this exists (the scaling story): a training loop calls ``Snapshot.take``
+every N steps with an *identical* state structure, shardings, and world size
+— only the values (and the destination path) change. The reference re-pays
+the full coordination bill on every take: key all_gather + a barrier per key,
+partition all_gather, hostname all_gather, manifest gather (reference
+``snapshot.py:354-370,425``; ``partitioner.py:126-144``;
+``scheduler.py:45-65``). Each all_gather costs O(world) store reads on
+*every* rank, so the per-take stall grows linearly with world size — the
+visible threat to a <5 s stall budget at pod scale (v5e-256).
+
+The design here collapses a steady-state take to **constant per-rank store
+traffic**:
+
+1. Every rank flattens its local state (no collectives) and hashes a
+   *fingerprint* of everything that shapes the plan: logical paths, leaf
+   shapes/dtypes/shardings, world size, replicated globs, and the planning
+   knobs — but NOT values or the destination path.
+2. One **preflight** round — ``gather_object`` to rank 0 + one
+   ``broadcast_object`` back (a constant 2 store ops per non-zero rank) —
+   carries ``(path, base, globs, plan_token)``. Rank 0 resolves the
+   canonical path/base (rank 0 wins, with divergence warnings — reference
+   ``snapshot.py:789-826`` semantics), intersects replicated globs, and
+   decides HIT iff every rank holds a cached plan for its own (rank-local)
+   fingerprint and all plans carry the same take-sequence token — i.e. they
+   were computed together by one earlier take.
+3. On a HIT the take reuses the cached replicated-write partition assignment
+   and the cached local-world-size (so the partition all_gather and the
+   hostname all_gather are skipped), and the manifest gather shrinks to a
+   per-rank **delta** against the previous take's entries (typically just
+   the step counter and other inline primitives).
+
+A rank whose structure changed reports a different fingerprint, rank 0
+broadcasts MISS, and every rank runs the full path — ranks can never diverge
+on which collectives they issue, because the decision itself is a collective.
+
+Correctness notes:
+
+- The fingerprint deliberately excludes values: value changes flow through
+  the delta manifest gather, which diffs *entry dicts* (so even entries that
+  change for reasons outside the fingerprint — e.g. relocated slab paths —
+  are re-gathered correctly).
+- ``have_cached_plan`` also reflects the local knob, so disabling
+  ``TORCHSNAPSHOT_TPU_PLAN_CACHE`` on any one rank safely forces a global
+  MISS (never a deadlock).
+- World size 1 runs no collectives at all; the cache is bypassed (there is
+  nothing to save).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .manifest import Manifest
+from .parallel.coordinator import Coordinator
+from .utils import knobs
+
+logger = logging.getLogger(__name__)
+
+# Bump when the fingerprint payload or cached-plan layout changes: stale
+# in-process caches from an older scheme must never satisfy a new build.
+_FINGERPRINT_VERSION = 1
+
+# Per-coordinator bound on retained plans (a loop alternating a few distinct
+# app-state structures — e.g. model-only vs full-state checkpoints — keeps
+# hitting; an unbounded cache would leak manifests for abandoned structures).
+_MAX_CACHED_PLANS = 4
+
+
+def _is_jax_array(obj: Any) -> bool:
+    import jax
+
+    return isinstance(obj, jax.Array)
+
+
+def _leaf_descriptor(value: Any, world_size: int) -> Tuple:
+    """Everything about one leaf that shapes the plan — never its values.
+
+    For jax arrays this includes the addressable shard indices, replica ids
+    and device ids: the sharded preparer's shard list, the replicated
+    classification, and the per-rank write set are all functions of these
+    (``io_preparer.classify``, ``io_preparers/sharded_array.py``).
+    """
+    from .io_preparer import classify
+
+    kind = classify(value, world_size)
+    if kind in ("primitive", "object"):
+        return (kind, type(value).__name__)
+    if isinstance(value, np.ndarray):
+        return (kind, value.dtype.str, tuple(value.shape))
+    # jax array (sharded / replicated_array / array)
+    shards = tuple(
+        (
+            tuple(
+                (s.start, s.stop, s.step) if isinstance(s, slice) else s
+                for s in (
+                    shard.index
+                    if isinstance(shard.index, tuple)
+                    else (shard.index,)
+                )
+            ),
+            shard.replica_id,
+            shard.device.id,
+        )
+        for shard in value.addressable_shards
+    )
+    return (
+        kind,
+        str(value.dtype),
+        tuple(value.shape),
+        bool(value.sharding.is_fully_replicated),
+        shards,
+    )
+
+
+def compute_fingerprint(
+    flattened: Dict[str, Any],
+    world_size: int,
+    replicated_globs: List[str],
+) -> str:
+    """Hash of the plan-shaping inputs (structure + shardings + knobs)."""
+    knob_sig = (
+        knobs.get_max_chunk_size_bytes(),
+        knobs.get_max_shard_size_bytes(),
+        knobs.get_slab_size_threshold_bytes(),
+        knobs.is_batching_enabled(),
+        knobs.get_compression(),
+        knobs.get_compression_level(),
+        knobs.is_checksums_enabled(),
+        knobs.is_dedup_digests_enabled(),
+    )
+    payload = (
+        _FINGERPRINT_VERSION,
+        world_size,
+        tuple(sorted(set(replicated_globs))),
+        knob_sig,
+        tuple(
+            (path, _leaf_descriptor(value, world_size))
+            for path, value in sorted(flattened.items())
+        ),
+    )
+    return hashlib.sha256(repr(payload).encode()).hexdigest()
+
+
+@dataclass
+class CachedPlan:
+    """What a cache hit reuses (per fingerprint, per process)."""
+
+    # The take sequence number at which this plan was stored. Takes are SPMD,
+    # so the counter advances in lockstep across ranks and "all ranks hold a
+    # plan with the SAME token" certifies the plans were computed together —
+    # guarding against ranks hitting plans from *different* past takes whose
+    # partition assignments don't compose (possible when ranks alternate
+    # among several cached structures out of phase).
+    token: int
+    # Replicated storage path -> writer rank (partitioner output).
+    assignment: Dict[str, int]
+    # This rank's last take's manifest as {logical_path: entry_dict} — the
+    # delta baseline for the next manifest gather.
+    local_entry_dicts: Dict[str, dict]
+    # Rank 0 only: every rank's last entry dicts (same delta baseline,
+    # receiver side). None on other ranks.
+    gathered_entry_dicts: Optional[List[Dict[str, dict]]]
+
+
+@dataclass
+class PreflightResult:
+    hit: bool
+    path: str
+    base: Optional[str]
+    replicated_globs: List[str]
+
+
+@dataclass
+class TakePlan:
+    """Output of the planning stage, consumed by ``Snapshot._take_impl``."""
+
+    path: str
+    base: Optional[str]
+    replicated_globs: List[str]
+    flattened: Dict[str, Any]
+    manifest: Manifest  # container entries from flatten()
+    rng_states: List[Tuple[str, Any, Any]]
+    fingerprint: str
+    cache_hit: bool
+    cached: Optional[CachedPlan]
+    phases: Dict[str, float] = field(default_factory=dict)
+
+
+def get_plan_cache(coord: Coordinator) -> "Dict[str, CachedPlan]":
+    """The per-process plan cache, attached to the (long-lived) coordinator
+    so tests that build private coordinators get private caches."""
+    cache = getattr(coord, "_take_plan_cache", None)
+    if cache is None:
+        cache = {}
+        coord._take_plan_cache = cache  # type: ignore[attr-defined]
+    return cache
+
+
+def store_plan(coord: Coordinator, fingerprint: str, plan: CachedPlan) -> None:
+    cache = get_plan_cache(coord)
+    cache.pop(fingerprint, None)
+    cache[fingerprint] = plan
+    while len(cache) > _MAX_CACHED_PLANS:
+        cache.pop(next(iter(cache)))
+
+
+def preflight(
+    coord: Coordinator,
+    path: str,
+    base: Optional[str],
+    replicated_globs: List[str],
+    plan_token: Optional[int],
+) -> PreflightResult:
+    """One gather + one broadcast replacing the per-take path/glob/base/key
+    all_gathers and deciding hit/miss globally (see module docstring).
+
+    ``plan_token`` is the rank's cached plan's take-sequence token (None if
+    it holds no plan for its local fingerprint). The fingerprint itself is
+    deliberately rank-LOCAL — sharded arrays give every rank different
+    addressable shards, so fingerprints legitimately differ across ranks —
+    and never crosses the wire; hit requires every rank to hold a plan and
+    all tokens to match (i.e. all plans were computed by the same take).
+    """
+    globs_local = sorted(set(replicated_globs))
+    if coord.get_world_size() == 1:
+        return PreflightResult(
+            hit=False, path=path, base=base, replicated_globs=globs_local
+        )
+    gathered = coord.gather_object(
+        (path, base, globs_local, plan_token), dst=0
+    )
+    decision: Optional[Tuple[bool, str, Optional[str], List[str]]] = None
+    if gathered is not None:  # rank 0
+        paths = [g[0] for g in gathered]
+        bases = [g[1] for g in gathered]
+        globs = [g[2] for g in gathered]
+        tokens = [g[3] for g in gathered]
+        if any(p != paths[0] for p in paths):
+            logger.warning(
+                "Rank-divergent snapshot paths %s; using rank 0's: %s",
+                paths,
+                paths[0],
+            )
+        if any(b != bases[0] for b in bases):
+            logger.warning(
+                "Rank-divergent base snapshots %s; using rank 0's: %s",
+                bases,
+                bases[0],
+            )
+        common: Set[str] = set(globs[0])
+        for g in globs[1:]:
+            common &= set(g)
+        dropped = set().union(*map(set, globs)) - common
+        if dropped:
+            logger.warning(
+                "Ignoring rank-asymmetric replicated globs: %s", dropped
+            )
+        hit = tokens[0] is not None and all(t == tokens[0] for t in tokens)
+        decision = (hit, paths[0], bases[0], sorted(common))
+    decision = coord.broadcast_object(decision, src=0)
+    hit, canonical_path, canonical_base, common_globs = decision
+    return PreflightResult(
+        hit=hit,
+        path=canonical_path,
+        base=canonical_base,
+        replicated_globs=common_globs,
+    )
+
+
+def gather_manifest_delta(
+    manifest: Manifest,
+    coord: Coordinator,
+    cached: CachedPlan,
+) -> Optional[Manifest]:
+    """Cache-hit replacement for the full manifest gather: each rank sends
+    only the entries whose serialized dict changed since the previous take
+    (plus any paths that vanished — defensive; the fingerprint should make
+    that impossible). Returns the global manifest on rank 0, None elsewhere.
+
+    Updates ``cached`` in place on every rank so the next take diffs against
+    this one.
+    """
+    from .manifest import entry_from_dict, entry_to_dict
+    from .partitioner import consolidate_replicated_entries
+
+    local = {p: entry_to_dict(e) for p, e in manifest.items()}
+    delta = {
+        p: d for p, d in local.items() if cached.local_entry_dicts.get(p) != d
+    }
+    removed = [p for p in cached.local_entry_dicts if p not in local]
+    gathered = coord.gather_object((delta, removed), dst=0)
+    cached.local_entry_dicts = local
+    if gathered is None:
+        return None
+    assert cached.gathered_entry_dicts is not None
+    new_gathered: List[Dict[str, dict]] = []
+    for r, (dlt, dels) in enumerate(gathered):
+        merged = dict(cached.gathered_entry_dicts[r])
+        merged.update(dlt)
+        for p in dels:
+            merged.pop(p, None)
+        new_gathered.append(merged)
+    cached.gathered_entry_dicts = new_gathered
+    global_manifest: Manifest = {
+        f"{r}/{p}": entry_from_dict(d)
+        for r, m in enumerate(new_gathered)
+        for p, d in m.items()
+    }
+    consolidate_replicated_entries(global_manifest)
+    return global_manifest

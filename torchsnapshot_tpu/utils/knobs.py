@@ -290,6 +290,23 @@ def override_dedup_digests(enabled: bool):
     return _override_env(_ENV_DEDUP_DIGESTS, "1" if enabled else "0")
 
 
+_ENV_PLAN_CACHE = "TORCHSNAPSHOT_TPU_PLAN_CACHE"
+
+
+def is_plan_cache_enabled() -> bool:
+    """Reuse the take plan (partition assignment, coalesced globs, manifest
+    baseline) across takes of an identical app-state structure, shrinking a
+    steady-state take's coordination to constant per-rank store traffic
+    (see ``take_plan.py``). The fingerprint check makes a hit safe; this
+    knob exists for A/B measurement and as an escape hatch. A rank with the
+    cache disabled forces a global miss — never a hang."""
+    return os.environ.get(_ENV_PLAN_CACHE, "1") not in ("0", "false", "False")
+
+
+def override_plan_cache(enabled: bool):
+    return _override_env(_ENV_PLAN_CACHE, "1" if enabled else "0")
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
