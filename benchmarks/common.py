@@ -1,0 +1,21 @@
+"""Shared helpers for the benchmark harnesses."""
+
+import os
+
+
+def maybe_init_distributed() -> None:
+    """Join a multi-host run when ``BENCH_DISTRIBUTED=1`` (exported by
+    ``benchmarks/run_tpu_vm.sh`` on every pod worker).
+
+    On Cloud TPU, ``jax.distributed.initialize()`` auto-configures the
+    coordinator address, process id, and process count from the TPU metadata
+    service — no flags needed. Once initialized, the library's coordinator
+    rides the jax coordination service, every host writes its partition of
+    each checkpoint, and the benchmark's printed per-host numbers aggregate
+    across ``jax.process_count()`` hosts. Must run before any other jax
+    call. A no-op in local runs.
+    """
+    if os.environ.get("BENCH_DISTRIBUTED") in ("1", "true"):
+        import jax
+
+        jax.distributed.initialize()

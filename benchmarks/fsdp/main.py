@@ -18,8 +18,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from benchmarks.common import maybe_init_distributed  # noqa: E402
+
 
 def main() -> None:
+    maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--layers", type=int, default=4)
     parser.add_argument("--d-model", type=int, default=1024)
@@ -76,7 +79,10 @@ def main() -> None:
         load_s = time.perf_counter() - t0
         print(f"restore: {load_s:.2f}s ({gb / load_s:.2f} GB/s)")
         ok = all(
-            np.array_equal(np.asarray(a).view(np.uint8), np.asarray(b).view(np.uint8))
+            np.array_equal(
+                np.ascontiguousarray(np.asarray(a)).view(np.uint8),
+                np.ascontiguousarray(np.asarray(b)).view(np.uint8),
+            )
             for a, b in zip(
                 jax.tree_util.tree_leaves(params),
                 jax.tree_util.tree_leaves(restored.value),

@@ -18,8 +18,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from benchmarks.common import maybe_init_distributed  # noqa: E402
+
 
 def main() -> None:
+    maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--frozen-gb", type=float, default=1.0)
     parser.add_argument("--adapter-mb", type=float, default=16.0)

@@ -20,8 +20,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from benchmarks.common import maybe_init_distributed  # noqa: E402
+
 
 def main() -> None:
+    maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--layers", type=int, default=4)
     parser.add_argument("--d-model", type=int, default=1024)
@@ -99,8 +102,8 @@ def main() -> None:
 
         ok = all(
             np.array_equal(
-                np.asarray(a).reshape(-1).view(np.uint8),
-                np.asarray(b).reshape(-1).view(np.uint8),
+                np.ascontiguousarray(np.asarray(a)).reshape(-1).view(np.uint8),
+                np.ascontiguousarray(np.asarray(b)).reshape(-1).view(np.uint8),
             )
             for a, b in zip(
                 (x for x in jax.tree_util.tree_leaves(state) if hasattr(x, "dtype")),

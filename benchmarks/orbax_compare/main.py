@@ -24,8 +24,11 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from benchmarks.common import maybe_init_distributed  # noqa: E402
+
 
 def main() -> None:
+    maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gb", type=float, default=0.5)
     parser.add_argument("--cpu", action="store_true")
@@ -92,8 +95,8 @@ def main() -> None:
         restore_s = time.perf_counter() - t0
         for k in params:
             assert (
-                np.asarray(tgt[k]).view(np.uint8).tobytes()
-                == np.asarray(params[k]).view(np.uint8).tobytes()
+                np.ascontiguousarray(np.asarray(tgt[k])).view(np.uint8).tobytes()
+                == np.ascontiguousarray(np.asarray(params[k])).view(np.uint8).tobytes()
             ), f"torchsnapshot_tpu restore mismatch at {k}"
         return stall, total, restore_s
 
@@ -122,8 +125,8 @@ def main() -> None:
         restore_s = time.perf_counter() - t0
         for k in params:
             assert (
-                np.asarray(restored[k]).view(np.uint8).tobytes()
-                == np.asarray(params[k]).view(np.uint8).tobytes()
+                np.ascontiguousarray(np.asarray(restored[k])).view(np.uint8).tobytes()
+                == np.ascontiguousarray(np.asarray(params[k])).view(np.uint8).tobytes()
             ), f"orbax restore mismatch at {k}"
         ckptr.close()
         restorer.close()

@@ -19,6 +19,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from benchmarks.common import maybe_init_distributed  # noqa: E402
+
 
 def _make_state(total_gb: float):
     n = max(1, int(total_gb * 1e9 / (64 * 1024 * 1024)))
@@ -44,6 +46,7 @@ def _worker(rank: int, world_size: int, shared: str, total_gb: float) -> None:
 
 
 def main() -> None:
+    maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gb", type=float, default=1.0)
     parser.add_argument("--nproc", type=int, default=4)

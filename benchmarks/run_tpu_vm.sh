@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Multi-host TPU-pod launch recipe for every benchmark in this directory —
+# the TPU analogue of the reference's per-benchmark `run.slurm`
+# (e.g. /root/reference/benchmarks/fsdp/run.slurm, which wraps
+# torch.distributed.run under SLURM). On Cloud TPU there is no SLURM: the
+# pod's hosts are addressed with `gcloud ... tpu-vm ssh --worker=all`, and
+# jax.distributed discovers peers through the TPU metadata service, so the
+# same command runs unmodified on every worker.
+#
+# Usage (from your workstation):
+#
+#   ./run_tpu_vm.sh <tpu-name> <zone> <benchmark> [args...]
+#
+#   ./run_tpu_vm.sh v5e-pod us-west4-a stall --steps 5
+#   ./run_tpu_vm.sh v5e-pod us-west4-a fsdp --ckpt-path gs://my-bucket/bench
+#
+# What it does on every worker:
+#   1. syncs this repository to the worker (rsync over ssh);
+#   2. runs the benchmark with `jax.distributed.initialize()` auto-config —
+#      on Cloud TPU, coordinator address/rank/world come from the metadata
+#      service, no flags needed;
+#   3. the checkpoint target should be a GCS bucket (gs://...) reachable
+#      from the pod's service account; per-host local paths also work for
+#      single-host measurements but do NOT produce a restorable pod
+#      snapshot unless the filesystem is shared.
+#
+# Knobs worth setting at pod scale (exported below, override via env):
+#   TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S — commit barriers legitimately wait
+#     for the SLOWEST host's data drain; size it at (bytes_per_host /
+#     worst-case GB/s to the bucket) + headroom. This script exports 600 s
+#     (covers ~250 GB/host at 0.5 GB/s); raise it for bigger states.
+#   TORCHSNAPSHOT_TPU_GCS_CHUNK_BYTES — resumable-upload chunk (default
+#     100 MB): smaller chunks re-send less on a fault, larger chunks make
+#     fewer round-trips.
+#
+# Preemption behavior (what to expect): if any host dies mid-take, the
+# commit barrier propagates the failure and NO `.snapshot_metadata` is
+# written — the previous snapshot stays the newest committed one, and the
+# restarted job resumes from it (tests/test_async_take.py drills this with
+# SIGKILL). Partially-written objects of the aborted take are overwritten
+# by the next take to the same path or cleaned by a bucket lifecycle rule.
+
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+BENCH=${3:?benchmark dir under benchmarks/}
+shift 3
+
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+REMOTE_DIR=/tmp/torchsnapshot_tpu_bench
+
+echo ">>> syncing repo to all workers"
+gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
+  "$REPO_DIR" "$TPU_NAME:$REMOTE_DIR"
+
+echo ">>> running benchmarks/$BENCH on all workers"
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all --zone="$ZONE" \
+  --command="
+    export BENCH_DISTRIBUTED=1
+    export TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S=\${TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S:-600}
+    cd $REMOTE_DIR && python3 benchmarks/$BENCH/main.py $*"

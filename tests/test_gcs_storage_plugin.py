@@ -555,3 +555,193 @@ def test_resumable_upload_lost_final_ack_treated_as_committed(
     _run(plugin.close())
     assert blobs["acked"] == payload
     assert stats["recovers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Emulator-backed wire-path tests: the REAL google-cloud-storage +
+# google-resumable-media SDKs against a local fake GCS server
+# (tests/gcs_emulator.py) via STORAGE_EMULATOR_HOST. These cover what the
+# monkeypatch-faked tests above cannot: the multipart upload body, the
+# resumable session protocol (308/Range cursors, `bytes */N` recovery
+# probes), ranged media downloads, and the rewrite-token loop — without any
+# cloud credentials (VERDICT round 2, next-round item 3).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def gcs_emulator(monkeypatch):
+    from gcs_emulator import FakeGCSServer
+
+    with FakeGCSServer() as srv:
+        monkeypatch.setenv("STORAGE_EMULATOR_HOST", srv.endpoint)
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "test-project")
+        yield srv
+
+
+def _emulator_plugin(root="bkt/pre"):
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    return GCSStoragePlugin(root)
+
+
+def test_emulator_small_object_multipart_roundtrip(gcs_emulator) -> None:
+    plugin = _emulator_plugin()
+    loop = asyncio.new_event_loop()
+    try:
+        data = b"payload-" * 1000
+        loop.run_until_complete(plugin.write(WriteIO(path="a/b", buf=data)))
+        rio = ReadIO(path="a/b")
+        loop.run_until_complete(plugin.read(rio))
+        assert rio.buf.getvalue() == data
+        # Ranged read travels as an inclusive HTTP Range on the media URL.
+        rio2 = ReadIO(path="a/b", byte_range=(8, 24))
+        loop.run_until_complete(plugin.read(rio2))
+        assert rio2.buf.getvalue() == data[8:24]
+        loop.run_until_complete(plugin.delete("a/b"))
+        with pytest.raises(FileNotFoundError):
+            loop.run_until_complete(plugin.read(ReadIO(path="a/b")))
+        # The multipart upload wire path was actually used.
+        assert any(
+            "uploadType=multipart" in line
+            for line in gcs_emulator.state.request_log
+        )
+    finally:
+        loop.run_until_complete(plugin.close())
+        loop.close()
+
+
+def test_emulator_resumable_upload_survives_chunk_fault(gcs_emulator) -> None:
+    """A 503 on one chunk PUT is absorbed by the stack (google-resumable-
+    media's internal retry re-sends the chunk over the real wire; the
+    plugin's cursor recovery is the second line of defense for faults that
+    escape it) and the upload completes byte-exact."""
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    plugin = _emulator_plugin()
+    loop = asyncio.new_event_loop()
+    try:
+        data = bytes(range(256)) * 8192  # 2 MiB
+        with _knobs.override_gcs_chunk_bytes(256 * 1024):
+            gcs_emulator.fail_next("PUT /upload", n=1, status=503)
+            loop.run_until_complete(plugin.write(WriteIO(path="big", buf=data)))
+        rio = ReadIO(path="big")
+        loop.run_until_complete(plugin.read(rio))
+        assert rio.buf.getvalue() == data
+        log = gcs_emulator.state.request_log
+        assert any("uploadType=resumable" in line for line in log)
+        # 8 chunks + at least one retransmit of the faulted chunk.
+        assert sum(1 for line in log if "PUT /upload" in line) >= 9
+    finally:
+        loop.run_until_complete(plugin.close())
+        loop.close()
+
+
+def test_emulator_session_recover_speaks_real_wire_protocol(gcs_emulator) -> None:
+    """The plugin's `_GoogleResumableSession.recover` against the real
+    protocol: a `bytes */N` status probe whose `308 + Range` reply resets
+    the client cursor to the server's persisted offset."""
+    from torchsnapshot_tpu.storage_plugins.gcs import _GoogleResumableSession
+    from torchsnapshot_tpu.storage_plugins.gcs import _make_authorized_session
+
+    plugin = _emulator_plugin()
+    try:
+        data = bytes(range(256)) * 4096  # 1 MiB
+        session = _GoogleResumableSession(
+            plugin._client,
+            "bkt",
+            "recov",
+            memoryview(data),
+            256 * 1024,
+            transport_factory=lambda: _make_authorized_session(plugin._client),
+        )
+        session.transmit_next_chunk()
+        assert session.bytes_uploaded == 256 * 1024
+        # Simulate an escaped mid-chunk fault: the upload is marked invalid,
+        # exactly the state the plugin's recovery path handles.
+        session._upload._invalid = True
+        session.recover()
+        assert session.bytes_uploaded == 256 * 1024
+        assert any(
+            line.startswith("PROBE") for line in gcs_emulator.state.request_log
+        )
+        while not session.finished:
+            session.transmit_next_chunk()
+        loop = asyncio.new_event_loop()
+        rio = ReadIO(path="recov")
+        # Raw bucket object (no plugin prefix was used for this session).
+        from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+        raw = GCSStoragePlugin("bkt")
+        try:
+            loop.run_until_complete(raw.read(rio))
+        finally:
+            loop.run_until_complete(raw.close())
+            loop.close()
+        assert rio.buf.getvalue() == data
+    finally:
+        loop2 = asyncio.new_event_loop()
+        loop2.run_until_complete(plugin.close())
+        loop2.close()
+
+
+def test_emulator_transient_download_faults_retried(gcs_emulator) -> None:
+    plugin = _emulator_plugin()
+    loop = asyncio.new_event_loop()
+    try:
+        data = b"x" * 4096
+        loop.run_until_complete(plugin.write(WriteIO(path="obj", buf=data)))
+        gcs_emulator.fail_next("GET /download", n=2, status=503)
+        rio = ReadIO(path="obj")
+        loop.run_until_complete(plugin.read(rio))
+        assert rio.buf.getvalue() == data
+    finally:
+        loop.run_until_complete(plugin.close())
+        loop.close()
+
+
+def test_emulator_link_in_rewrite_token_loop(gcs_emulator) -> None:
+    """Server-side copy via the real rewrite API, including a forced
+    multi-round token loop (big/cross-class copies return tokens)."""
+    plugin = _emulator_plugin()
+    loop = asyncio.new_event_loop()
+    try:
+        data = b"frozen-weights" * 100
+        loop.run_until_complete(plugin.write(WriteIO(path="base_obj", buf=data)))
+        gcs_emulator.force_rewrite_token_rounds(1)
+        ok = loop.run_until_complete(
+            plugin.link_in("gs://bkt/pre/base_obj", "copied_obj")
+        )
+        assert ok
+        rio = ReadIO(path="copied_obj")
+        loop.run_until_complete(plugin.read(rio))
+        assert rio.buf.getvalue() == data
+        rewrites = [
+            line
+            for line in gcs_emulator.state.request_log
+            if "rewriteTo" in line
+        ]
+        assert len(rewrites) >= 2  # token round + completion round
+        assert any("rewriteToken=" in line for line in rewrites)
+    finally:
+        loop.run_until_complete(plugin.close())
+        loop.close()
+
+
+def test_emulator_snapshot_end_to_end(gcs_emulator) -> None:
+    """Full Snapshot.take/restore/read_object/verify against gs:// through
+    the real SDK wire path."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    arr = np.arange(4096, dtype=np.float32)
+    path = "gs://bkt/snapshots/s1"
+    Snapshot.take(path, {"s": StateDict(arr=arr, step=3)})
+    out = {"s": StateDict(arr=np.zeros(4096, dtype=np.float32), step=0)}
+    snap = Snapshot(path)
+    snap.restore(out)
+    assert np.array_equal(out["s"]["arr"], arr)
+    assert out["s"]["step"] == 3
+    got = snap.read_object("0/s/arr", memory_budget_bytes=4096)
+    assert np.array_equal(got, arr)
+    assert snap.verify() == {}

@@ -35,7 +35,9 @@ from ..serialization import (
     array_from_bytes,
     array_nbytes,
     codec_for_raw_serializer,
+    compress_framed,
     compress_payload,
+    decode_framed_payload,
     decode_raw_payload,
     dtype_to_string,
     ensure_codec_available,
@@ -44,6 +46,12 @@ from ..serialization import (
     raw_serializer_for_codec,
 )
 from ..utils import knobs
+
+# Side-object suffix carrying a framed payload's compressed frame sizes
+# (tiny JSON). Written by the same pipeline as the payload; read only by
+# budgeted sub-reads (whole-object reads decode concatenated frames without
+# a table).
+FRAME_TABLE_SUFFIX = ".ftab"
 
 
 def _is_jax_array(obj: Any) -> bool:
@@ -89,8 +97,25 @@ class ArrayBufferStager(BufferStager):
             self.compression_level = knobs.get_compression_level(
                 _codec=codec_for_raw_serializer(entry.serializer)
             )
+        # Compressed frame sizes, published by stage_buffer for framed
+        # entries; the companion FrameTableStager polls for it. A staging
+        # failure publishes frame_error instead so the poller fails fast
+        # rather than spinning as an orphaned task.
+        self.frame_sizes: Optional[List[int]] = None
+        self.frame_error: Optional[BaseException] = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        if not self.entry.frame_bytes:
+            return await self._stage_inner(executor)
+        try:
+            return await self._stage_inner(executor)
+        except BaseException as e:  # noqa: BLE001 - published, then re-raised
+            # Any failure (D2H error, compressor OOM, cancellation) must
+            # unblock the companion FrameTableStager's poll.
+            self.frame_error = e
+            raise
+
+    async def _stage_inner(self, executor: Optional[Executor] = None) -> BufferType:
         arr = self.arr
         if _is_jax_array(arr):
             host = await to_host(arr, executor)()
@@ -116,6 +141,22 @@ class ArrayBufferStager(BufferStager):
             view = array_as_bytes_view(host)
             level = self.compression_level
             loop = asyncio.get_event_loop()
+            if self.entry.frame_bytes:
+                def framed():
+                    payload, sizes = compress_framed(
+                        view,
+                        self.entry.serializer,
+                        level,
+                        self.entry.frame_bytes,
+                    )
+                    # Publish for the companion FrameTableStager (same
+                    # pipeline, polls until this lands).
+                    self.frame_sizes = sizes
+                    return payload
+
+                if executor is not None:
+                    return await loop.run_in_executor(executor, framed)
+                return framed()
             if executor is not None:
                 return await loop.run_in_executor(
                     executor, compress_payload, view, self.entry.serializer, level
@@ -140,6 +181,134 @@ class ArrayBufferStager(BufferStager):
                 self.arr.copy_to_host_async()
             except Exception:  # pragma: no cover - platform-specific hint
                 pass
+
+
+class FrameTableStager(BufferStager):
+    """Stages a framed payload's ``<location>.ftab`` side object: tiny JSON
+    ``{"frame_bytes": F, "sizes": [...]}``.
+
+    The sizes exist only after the main stager compressed the payload (which
+    is why they can't live in the manifest — it is gathered before staging),
+    so this stager polls the main stager's published result. Both requests
+    run in the same pipeline; the poll holds no executor thread and the main
+    request always runs (dedup link-in decisions happen after staging), so
+    this terminates.
+    """
+
+    def __init__(self, main: ArrayBufferStager) -> None:
+        self.main = main
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        import json
+
+        while self.main.frame_sizes is None:
+            if self.main.frame_error is not None:
+                raise RuntimeError(
+                    f"frame table for {self.main.entry.location} unavailable: "
+                    "payload staging failed"
+                ) from self.main.frame_error
+            await asyncio.sleep(0.005)
+        return json.dumps(
+            {
+                "frame_bytes": self.main.entry.frame_bytes,
+                "sizes": self.main.frame_sizes,
+            }
+        ).encode()
+
+    def get_staging_cost_bytes(self) -> int:
+        # ~8 digits per frame size; a 4 GB payload at 8 MiB frames is ~4 KB.
+        return 8192
+
+    def start_d2h_hint(self) -> None:
+        pass  # no device data of its own
+
+
+def plan_frame_groups(
+    frame_sizes: Sequence[int],
+    frame_bytes: int,
+    raw_begin: int,
+    raw_end: int,
+    budget: Optional[int],
+) -> List[Tuple[int, int, int, int]]:
+    """Split the raw range [raw_begin, raw_end) into frame-aligned groups.
+
+    Returns ``(comp_begin, comp_end, group_raw_begin, group_raw_end)`` per
+    group, where the comp range indexes the concatenated framed payload and
+    each group's raw coverage is <= max(budget, frame_bytes) (a single frame
+    wider than the budget is admitted whole — the usual one-over-budget
+    escape hatch).
+    """
+    prefix = [0]
+    for s in frame_sizes:
+        prefix.append(prefix[-1] + int(s))
+    first = raw_begin // frame_bytes
+    last = (raw_end + frame_bytes - 1) // frame_bytes  # exclusive
+    per_group = max(1, (budget or raw_end) // frame_bytes)
+    groups: List[Tuple[int, int, int, int]] = []
+    i = first
+    while i < last:
+        j = min(i + per_group, last)
+        groups.append(
+            (prefix[i], prefix[j], i * frame_bytes, min(j * frame_bytes, raw_end))
+        )
+        i = j
+    return groups
+
+
+class FramedSliceConsumer(BufferConsumer):
+    """Decompresses one group of frames and delivers the requested raw slice.
+
+    ``deliver`` receives a memoryview of raw bytes covering
+    [raw_begin, raw_end) of the entry's serialized layout; the group's
+    frames may cover a superset (frame alignment), which is sliced off.
+    """
+
+    def __init__(
+        self,
+        serializer: str,
+        group_raw_begin: int,
+        raw_begin: int,
+        raw_end: int,
+        deliver: Callable[[memoryview], None],
+        decoded_raw_bytes: Optional[int] = None,
+    ) -> None:
+        self.serializer = serializer
+        self.group_raw_begin = group_raw_begin
+        self.raw_begin = raw_begin
+        self.raw_end = raw_end
+        self.deliver = deliver
+        # Frame alignment can force decoding more raw bytes than the
+        # requested slice; the budget must see the true peak.
+        self.decoded_raw_bytes = decoded_raw_bytes
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        def work() -> None:
+            raw = decode_framed_payload(buf, self.serializer)
+            off = self.raw_begin - self.group_raw_begin
+            self.deliver(
+                memoryview(raw)[off : off + (self.raw_end - self.raw_begin)]
+            )
+
+        loop = asyncio.get_event_loop()
+        if executor is not None:
+            await loop.run_in_executor(executor, work)
+        else:
+            work()
+
+    def get_consuming_cost_bytes(self) -> int:
+        # Compressed group + decompressed raw coexist during decode.
+        return 2 * (self.decoded_raw_bytes or (self.raw_end - self.raw_begin))
+
+
+def _flat_range_deliver(target: np.ndarray, begin: int, end: int):
+    flat = target.view(np.uint8).reshape(-1)
+
+    def deliver(mv: memoryview) -> None:
+        flat[begin:end] = np.frombuffer(mv, dtype=np.uint8)
+
+    return deliver
 
 
 def _nbytes_of(arr: Any) -> int:
@@ -190,7 +359,12 @@ class ArrayBufferConsumer(BufferConsumer):
     ) -> None:
         def work() -> None:
             if is_raw_family(self.entry.serializer):
-                raw = decode_raw_payload(buf, self.entry.serializer)
+                decode = (
+                    decode_framed_payload
+                    if self.entry.frame_bytes
+                    else decode_raw_payload
+                )
+                raw = decode(buf, self.entry.serializer)
                 src = array_from_bytes(raw, self.entry.dtype, self.entry.shape)
             else:
                 src = pickle.loads(bytes(buf))
@@ -251,28 +425,82 @@ class ArrayIOPreparer:
             serializer = raw_serializer_for_codec(knobs.get_compression())
         else:
             serializer = Serializer.PICKLE
+        frame_bytes = None
+        if serializer in (Serializer.RAW_ZSTD, Serializer.RAW_ZLIB):
+            f = knobs.get_compression_frame_bytes()
+            raw_nbytes = array_nbytes(
+                list(host_like.shape), dtype_to_string(dtype)
+            )
+            if f > 0 and raw_nbytes > f:
+                frame_bytes = f
         entry = ArrayEntry(
             location=storage_path,
             serializer=serializer,
             dtype=dtype_to_string(dtype) if is_raw_family(serializer) else str(dtype),
             shape=list(host_like.shape),
             replicated=replicated,
+            frame_bytes=frame_bytes,
         )
         stager = ArrayBufferStager(arr, entry, is_async_snapshot)
-        return entry, [WriteReq(path=storage_path, buffer_stager=stager)]
+        reqs = [WriteReq(path=storage_path, buffer_stager=stager)]
+        if frame_bytes:
+            reqs.append(
+                WriteReq(
+                    path=storage_path + FRAME_TABLE_SUFFIX,
+                    buffer_stager=FrameTableStager(stager),
+                )
+            )
+        return entry, reqs
 
     @staticmethod
     def prepare_read(
         entry: ArrayEntry,
         target: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,
+        frame_table: Optional[List[int]] = None,
     ) -> List[ReadReq]:
-        """Plan reads filling ``target`` (a writable host array)."""
+        """Plan reads filling ``target`` (a writable host array).
+
+        ``frame_table`` (the compressed frame sizes from the entry's
+        ``.ftab`` side object) enables budgeted sub-reads of framed
+        compressed entries: each read fetches one group of frames and
+        decompresses only those.
+        """
         ensure_codec_available(entry.serializer)
+        if (
+            entry.frame_bytes
+            and frame_table is not None
+            and buffer_size_limit_bytes is not None
+            and array_nbytes(entry.shape, entry.dtype) > buffer_size_limit_bytes
+        ):
+            base = entry.byte_range[0] if entry.byte_range else 0
+            raw_total = array_nbytes(entry.shape, entry.dtype)
+            reqs = []
+            for cb, ce, grb, gre in plan_frame_groups(
+                frame_table,
+                entry.frame_bytes,
+                0,
+                raw_total,
+                buffer_size_limit_bytes,
+            ):
+                reqs.append(
+                    ReadReq(
+                        path=entry.location,
+                        buffer_consumer=FramedSliceConsumer(
+                            entry.serializer,
+                            group_raw_begin=grb,
+                            raw_begin=grb,
+                            raw_end=gre,
+                            deliver=_flat_range_deliver(target, grb, gre),
+                        ),
+                        byte_range=(base + cb, base + ce),
+                    )
+                )
+            return reqs
         if entry.serializer != Serializer.RAW:
-            # Pickled and compressed payloads have no raw byte layout on
-            # storage: read the whole object (never budget-chunked), ranged
-            # only to a slab-relocated span if the entry records one.
+            # Pickled and (unframed, or unbudgeted) compressed payloads:
+            # read the whole object, ranged only to a slab-relocated span if
+            # the entry records one.
             return [
                 ReadReq(
                     path=entry.location,

@@ -85,6 +85,7 @@ class ChunkedArrayIOPreparer:
         entry: ChunkedArrayEntry,
         target: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,
+        frame_tables: Optional[dict] = None,
     ) -> List[ReadReq]:
         read_reqs: List[ReadReq] = []
         for chunk in entry.chunks:
@@ -92,6 +93,11 @@ class ChunkedArrayIOPreparer:
             r1 = r0 + chunk.sizes[0]
             view = target[r0:r1]
             read_reqs.extend(
-                ArrayIOPreparer.prepare_read(chunk.tensor, view, buffer_size_limit_bytes)
+                ArrayIOPreparer.prepare_read(
+                    chunk.tensor,
+                    view,
+                    buffer_size_limit_bytes,
+                    frame_table=(frame_tables or {}).get(chunk.tensor.location),
+                )
             )
         return read_reqs

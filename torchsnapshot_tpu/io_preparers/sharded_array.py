@@ -32,13 +32,14 @@ from ..manifest import ArrayEntry, Shard, ShardedArrayEntry
 from ..serialization import (
     Serializer,
     array_from_bytes,
+    decode_framed_payload,
     decode_raw_payload,
     ensure_codec_available,
     is_raw_family,
     string_to_dtype,
 )
 from ..utils import knobs
-from .array import ArrayIOPreparer
+from .array import ArrayIOPreparer, FramedSliceConsumer, plan_frame_groups
 
 # A target to restore into: (host buffer, global offsets, sizes)
 TargetShard = Tuple[np.ndarray, Sequence[int], Sequence[int]]
@@ -183,7 +184,12 @@ class ShardedArrayBufferConsumer(BufferConsumer):
     ) -> None:
         def work() -> None:
             if is_raw_family(self.entry.serializer):
-                raw = decode_raw_payload(buf, self.entry.serializer)
+                decode = (
+                    decode_framed_payload
+                    if self.entry.frame_bytes
+                    else decode_raw_payload
+                )
+                raw = decode(buf, self.entry.serializer)
                 src = array_from_bytes(raw, self.entry.dtype, self.entry.shape)
             else:
                 src = pickle.loads(bytes(buf))
@@ -204,6 +210,89 @@ class ShardedArrayBufferConsumer(BufferConsumer):
         from .array import entry_cost_bytes
 
         return entry_cost_bytes(self.entry)
+
+
+def _shard_piece_deliver(dtype_str: str, piece_shape, copy_specs):
+    """Deliver one decoded row-group: view as the piece array and scatter
+    into every overlapping destination (the framed analogue of
+    :class:`ShardedArrayBufferConsumer`)."""
+
+    def deliver(mv) -> None:
+        src = array_from_bytes(mv, dtype_str, piece_shape)
+        for dst, src_slices, dst_slices in copy_specs:
+            dst_view = dst[dst_slices] if dst_slices else dst
+            src_view = src[src_slices] if src_slices else src
+            np.copyto(dst_view, src_view, casting="no")
+
+    return deliver
+
+
+def _framed_shard_reads(
+    shard: Shard,
+    targets: List[TargetShard],
+    frame_table: List[int],
+    buffer_size_limit_bytes: int,
+) -> List[ReadReq]:
+    """Budgeted sub-reads of one FRAMED compressed shard: split into row
+    groups <= budget (raw), fetch each group's covering compression frames
+    by byte range, decompress only those, scatter the overlaps. A shard
+    never enters host memory whole."""
+    entry = shard.tensor
+    itemsize = string_to_dtype(entry.dtype).itemsize
+    F = entry.frame_bytes
+    base = entry.byte_range[0] if entry.byte_range else 0
+    row_bytes = (
+        int(np.prod(shard.sizes[1:])) * itemsize if shard.sizes else itemsize
+    )
+    shard_raw_total = (
+        int(np.prod(shard.sizes)) * itemsize if shard.sizes else itemsize
+    )
+    # A frame is the decompression quantum: pieces smaller than one frame's
+    # row coverage would each re-fetch and re-decode that whole frame (up to
+    # frame_bytes/budget amplification with a sub-frame budget), so clamp
+    # the effective piece size to >= one frame of rows.
+    effective = max(
+        buffer_size_limit_bytes,
+        ((F + row_bytes - 1) // row_bytes) * row_bytes,
+    )
+    if not shard.sizes:
+        pieces = [(shard.offsets, shard.sizes)]
+    else:
+        pieces = subdivide(shard.offsets, shard.sizes, itemsize, effective, dim=0)
+    prefix = [0]
+    for s in frame_table:
+        prefix.append(prefix[-1] + int(s))
+    reqs: List[ReadReq] = []
+    for off, sz in pieces:
+        copy_specs = []
+        for dst, dst_off, dst_sz in targets:
+            ov = overlap(off, sz, dst_off, dst_sz)
+            if ov is not None:
+                copy_specs.append((dst, ov[0], ov[1]))
+        if not copy_specs:
+            continue
+        a = (off[0] - shard.offsets[0]) * row_bytes if sz else 0
+        b = a + (int(np.prod(sz)) * itemsize if sz else itemsize)
+        # One group of covering frames per piece (the piece is already
+        # budget-sized; frame alignment adds at most 2 partial frames).
+        f0 = a // F
+        f1 = min(len(frame_table), (b + F - 1) // F)
+        cb, ce, grb = prefix[f0], prefix[f1], f0 * F
+        reqs.append(
+            ReadReq(
+                path=entry.location,
+                buffer_consumer=FramedSliceConsumer(
+                    entry.serializer,
+                    group_raw_begin=grb,
+                    raw_begin=a,
+                    raw_end=b,
+                    deliver=_shard_piece_deliver(entry.dtype, list(sz), copy_specs),
+                    decoded_raw_bytes=min(f1 * F, shard_raw_total) - grb,
+                ),
+                byte_range=(base + cb, base + ce),
+            )
+        )
+    return reqs
 
 
 class ShardedArrayIOPreparer:
@@ -255,6 +344,7 @@ class ShardedArrayIOPreparer:
         entry: ShardedArrayEntry,
         targets: List[TargetShard],
         buffer_size_limit_bytes: Optional[int] = None,
+        frame_tables: Optional[Dict[str, List[int]]] = None,
     ) -> List[ReadReq]:
         """Plan reads scattering saved shards into ``targets``.
 
@@ -265,10 +355,26 @@ class ShardedArrayIOPreparer:
         analogue of ``ArrayIOPreparer.prepare_read``'s budget chunking,
         reference ``io_preparers/tensor.py:120-166``) so ``read_object`` on an
         operator VM never holds more than ~budget bytes of any one shard.
+        FRAMED compressed shards (``frame_bytes`` set) get the same treatment
+        when their ``.ftab`` frame table is supplied: each row group maps to
+        the covering compression frames and only those bytes are fetched and
+        decompressed.
         """
         read_reqs: List[ReadReq] = []
         for shard in entry.shards:
             ensure_codec_available(shard.tensor.serializer)
+            table = (frame_tables or {}).get(shard.tensor.location)
+            if (
+                shard.tensor.frame_bytes
+                and table is not None
+                and buffer_size_limit_bytes is not None
+            ):
+                read_reqs.extend(
+                    _framed_shard_reads(
+                        shard, targets, table, buffer_size_limit_bytes
+                    )
+                )
+                continue
             base = tuple(shard.tensor.byte_range) if shard.tensor.byte_range else None
             for sub_off, sub_sz, byte_range in _budgeted_pieces(
                 shard, buffer_size_limit_bytes

@@ -89,6 +89,13 @@ class ArrayEntry(Entry):
     shape: List[int]
     replicated: bool = False
     byte_range: Optional[List[int]] = None  # [begin, end) within `location`
+    # Compressed entries only: raw bytes covered per independent compression
+    # frame. A framed payload is a concatenation of frames, each compressing
+    # `frame_bytes` of the raw stream (last one short), with the compressed
+    # frame sizes in a tiny `<location>.ftab` side object — that makes big
+    # compressed arrays byte-range addressable (budgeted sub-reads decompress
+    # only the covering frames). None = single-blob payload.
+    frame_bytes: Optional[int] = None
 
     def __init__(
         self,
@@ -98,6 +105,7 @@ class ArrayEntry(Entry):
         shape: List[int],
         replicated: bool = False,
         byte_range: Optional[List[int]] = None,
+        frame_bytes: Optional[int] = None,
     ):
         super().__init__(type="array")
         self.location = location
@@ -106,6 +114,7 @@ class ArrayEntry(Entry):
         self.shape = [int(s) for s in shape]
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
+        self.frame_bytes = int(frame_bytes) if frame_bytes else None
 
 
 @dataclass
@@ -240,6 +249,8 @@ def entry_to_dict(entry: Entry) -> Dict[str, Any]:
         )
         if entry.byte_range is not None:
             d["byte_range"] = entry.byte_range
+        if entry.frame_bytes is not None:
+            d["frame_bytes"] = entry.frame_bytes
     elif isinstance(entry, ShardedArrayEntry):
         d.update(
             dtype=entry.dtype,
@@ -295,6 +306,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             d["shape"],
             d.get("replicated", False),
             d.get("byte_range"),
+            d.get("frame_bytes"),
         )
     if t == "sharded_array":
         return ShardedArrayEntry(
@@ -335,15 +347,22 @@ class SnapshotMetadata:
     version: str
     world_size: int
     manifest: Manifest = field(default_factory=dict)
+    # Codec library versions in effect at take time (e.g. {"zstd": "0.25.0"})
+    # — recorded when compression was on so an incremental take can warn when
+    # its codec version differs from the base's: compressed bitstreams are
+    # only deterministic at a fixed library version, and a silent mismatch
+    # degrades dedup to full rewrites with no signal.
+    codec_versions: Optional[Dict[str, str]] = None
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "version": self.version,
-                "world_size": self.world_size,
-                "manifest": {k: entry_to_dict(v) for k, v in self.manifest.items()},
-            }
-        )
+        d: Dict[str, Any] = {
+            "version": self.version,
+            "world_size": self.world_size,
+            "manifest": {k: entry_to_dict(v) for k, v in self.manifest.items()},
+        }
+        if self.codec_versions:
+            d["codec_versions"] = self.codec_versions
+        return json.dumps(d)
 
     @classmethod
     def from_json(cls, s: str) -> "SnapshotMetadata":
@@ -352,6 +371,7 @@ class SnapshotMetadata:
             version=d["version"],
             world_size=int(d["world_size"]),
             manifest={k: entry_from_dict(v) for k, v in d["manifest"].items()},
+            codec_versions=d.get("codec_versions"),
         )
 
 

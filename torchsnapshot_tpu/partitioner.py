@@ -61,14 +61,27 @@ def partition_write_reqs_with_assignment(
     if world_size == 1:
         return write_reqs, {}
 
+    from .io_preparers.array import FRAME_TABLE_SUFFIX
+
     replicated_locations = set()
+    framed_partners = set()  # .ftab side objects bound to a replicated payload
     for entry in manifest.values():
         if is_replicated(entry):
+            subs = []
             if hasattr(entry, "location"):
-                replicated_locations.add(entry.location)
-            if hasattr(entry, "chunks"):
-                for chunk in entry.chunks:
-                    replicated_locations.add(chunk.tensor.location)
+                subs.append(entry)
+            for chunk in getattr(entry, "chunks", None) or []:
+                subs.append(chunk.tensor)
+            for sub in subs:
+                replicated_locations.add(sub.location)
+                if getattr(sub, "frame_bytes", None):
+                    # The frame-table stager polls its payload's stager, so
+                    # both objects MUST be written by the same rank; bind the
+                    # .ftab to its payload's assignment instead of letting
+                    # the greedy pass scatter them.
+                    partner = sub.location + FRAME_TABLE_SUFFIX
+                    replicated_locations.add(partner)
+                    framed_partners.add(partner)
 
     replicated_reqs = [r for r in write_reqs if r.path in replicated_locations]
     other_reqs = [r for r in write_reqs if r.path not in replicated_locations]
@@ -110,8 +123,13 @@ def partition_write_reqs_with_assignment(
 
     # Deterministic greedy: biggest request first onto the least-loaded rank.
     # Sort key includes the path so every rank breaks ties identically.
+    # Frame-table side objects don't participate — they follow their payload.
     items: List[Tuple[int, str]] = sorted(
-        ((_estimate(r), r.path) for r in replicated_reqs),
+        (
+            (_estimate(r), r.path)
+            for r in replicated_reqs
+            if r.path not in framed_partners
+        ),
         key=lambda t: (-t[0], t[1]),
     )
     assignment = {}
@@ -119,6 +137,10 @@ def partition_write_reqs_with_assignment(
         target = min(range(world_size), key=lambda r: (loads[r], r))
         assignment[path] = target
         loads[target] += size
+    for partner in framed_partners:
+        payload_path = partner[: -len(FRAME_TABLE_SUFFIX)]
+        if payload_path in assignment:
+            assignment[partner] = assignment[payload_path]
 
     return (
         other_reqs + [r for r in replicated_reqs if assignment[r.path] == rank],

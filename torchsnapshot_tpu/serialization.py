@@ -14,15 +14,18 @@ Serializer families:
 - ``raw``: little-endian C-contiguous raw bytes. Used for every dtype in
   :data:`SUPPORTED_DTYPES`. Enables ranged reads (a byte range of the
   serialized buffer corresponds to a contiguous region of the flat array).
-- ``raw_zstd`` / ``raw_zlib``: the raw byte stream compressed whole. Opt-in
-  via ``TORCHSNAPSHOT_TPU_COMPRESSION`` — on links/stores slower than the
+- ``raw_zstd`` / ``raw_zlib``: the raw byte stream compressed. Opt-in via
+  ``TORCHSNAPSHOT_TPU_COMPRESSION`` — on links/stores slower than the
   compressor (tunneled transports, cloud buckets, shared NVMe) the ~1.3-1.5x
   typical ratio on trained bf16/f32 weights directly multiplies effective
-  write throughput and shrinks checkpoints. The cost: compressed objects
-  are not byte-range addressable (budgeted sub-reads and slab batching fall
-  back to whole-object reads / unbatched writes). The serializer is
-  recorded per entry, so restore auto-detects regardless of current knobs,
-  and a compressed and an uncompressed snapshot can coexist.
+  write throughput and shrinks checkpoints. Payloads above
+  ``TORCHSNAPSHOT_TPU_COMPRESSION_FRAME_BYTES`` are FRAMED — independent
+  frames per fixed raw window, compressed frame sizes in a ``.ftab`` side
+  object — so budgeted sub-reads stay byte-range addressable (they fetch and
+  decompress only covering frames); smaller payloads are single blobs that
+  slab batching compresses eagerly at plan time so they coalesce too. The
+  serializer is recorded per entry, so restore auto-detects regardless of
+  current knobs, and a compressed and an uncompressed snapshot can coexist.
 - ``pickle``: ``pickle`` of arbitrary Python objects. Fallback for
   non-array leaves (reference used ``torch.save``; we have no torch
   dependency on the TPU path).
@@ -114,6 +117,68 @@ def decode_raw_payload(buf, serializer: str):
     if serializer == Serializer.RAW_ZLIB:
         return zlib.decompress(memoryview(buf))
     return buf
+
+
+def compress_framed(view, serializer: str, level: int, frame_bytes: int):
+    """Compress ``view`` as a sequence of independent frames, each covering
+    ``frame_bytes`` raw bytes (the last one short). Returns
+    ``(payload_bytes, frame_sizes)`` — frames are simply concatenated, so a
+    whole-payload read decodes with :func:`decode_framed_payload` and a
+    ranged read of frames [i, j) is byte range
+    ``[prefix[i], prefix[j])`` of the payload. Deterministic at a fixed
+    codec version + level (same property incremental dedup relies on for
+    single-blob payloads)."""
+    mv = memoryview(view)
+    parts = []
+    sizes = []
+    for begin in range(0, mv.nbytes, frame_bytes):
+        frame = compress_payload(
+            mv[begin : begin + frame_bytes], serializer, level
+        )
+        parts.append(frame)
+        sizes.append(len(frame))
+    return b"".join(parts), sizes
+
+
+def decode_framed_payload(buf, serializer: str):
+    """Decode a concatenation of compression frames back to raw bytes.
+
+    No frame table needed: zstd and zlib streams are self-terminating, so
+    concatenated frames decode by reading across frame boundaries.
+    """
+    if serializer == Serializer.RAW_ZSTD:
+        import zstandard
+
+        # stream_reader takes buffer-protocol sources directly — wrapping in
+        # BytesIO would copy the whole compressed payload first.
+        reader = zstandard.ZstdDecompressor().stream_reader(
+            memoryview(buf), read_across_frames=True
+        )
+        return reader.read()
+    if serializer == Serializer.RAW_ZLIB:
+        out = []
+        rest = memoryview(buf)
+        while rest.nbytes:
+            d = zlib.decompressobj()
+            out.append(d.decompress(rest))
+            rest = memoryview(d.unused_data)
+        return b"".join(out)
+    return buf
+
+
+def codec_library_versions() -> dict:
+    """Versions of the codec libraries in use, recorded in snapshot metadata
+    so incremental takes can warn when the base was compressed by a
+    different library version (bitstream determinism — hence dedup hit
+    rate — only holds within one version)."""
+    versions = {"zlib": zlib.ZLIB_RUNTIME_VERSION}
+    try:
+        import zstandard
+
+        versions["zstd"] = zstandard.__version__
+    except ImportError:  # pragma: no cover - zstd optional
+        pass
+    return versions
 
 
 def _build_dtype_table():

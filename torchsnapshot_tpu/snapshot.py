@@ -387,9 +387,17 @@ class Snapshot:
                 )
         # None on non-zero ranks: only the committing rank holds the global
         # manifest in memory; everyone else reads it lazily post-commit.
+        codec_versions = None
+        if knobs.get_compression() != "none":
+            from .serialization import codec_library_versions
+
+            codec_versions = codec_library_versions()
         metadata = (
             SnapshotMetadata(
-                version=__version__, world_size=world_size, manifest=global_manifest
+                version=__version__,
+                world_size=world_size,
+                manifest=global_manifest,
+                codec_versions=codec_versions,
             )
             if global_manifest is not None
             else None
@@ -493,6 +501,28 @@ class Snapshot:
                     base,
                 )
                 return None
+            codec = knobs.get_compression()
+            # Compressed bitstreams are deterministic only within one codec
+            # library version; a version change between base and incremental
+            # take silently degrades dedup to full rewrites — make that
+            # visible (ADVICE round 2, item 3). Only the ACTIVE codec
+            # matters, and only when the base recorded versions at all (an
+            # uncompressed or pre-versioning base has nothing to compare).
+            if codec != "none" and metadata.codec_versions:
+                from .serialization import codec_library_versions
+
+                recorded = metadata.codec_versions.get(codec)
+                current = codec_library_versions().get(codec)
+                if recorded is not None and recorded != current:
+                    logger.warning(
+                        "base=%s compressed its objects with %s %s but this "
+                        "take uses %s; byte-identical dedup will likely miss "
+                        "all compressed objects",
+                        base,
+                        codec,
+                        recorded,
+                        current,
+                    )
             merged, _, unreadable = _read_checksum_sidecars(
                 storage, metadata.world_size, event_loop
             )
@@ -606,6 +636,9 @@ class Snapshot:
         loaded: Dict[str, Any] = {}
         read_reqs: List[ReadReq] = []
         finalizers: List[Callable[[], None]] = []
+        frame_tables = _fetch_frame_tables(
+            entries.values(), storage, event_loop, _memory_budget_bytes_per_read
+        )
         for logical_path, entry in entries.items():
             reqs, finalize = _prepare_restore_one(
                 logical_path,
@@ -613,6 +646,7 @@ class Snapshot:
                 live_flattened.get(logical_path),
                 loaded,
                 buffer_size_limit_bytes=_memory_budget_bytes_per_read,
+                frame_tables=frame_tables,
             )
             read_reqs.extend(reqs)
             if finalize is not None:
@@ -679,12 +713,16 @@ class Snapshot:
             if isinstance(entry, PrimitiveEntry):
                 return entry.get_value()
             loaded: Dict[str, Any] = {}
+            frame_tables = _fetch_frame_tables(
+                [entry], storage, event_loop, memory_budget_bytes
+            )
             reqs, finalize = _prepare_restore_one(
                 logical_path,
                 entry,
                 obj_out,
                 loaded,
                 buffer_size_limit_bytes=memory_budget_bytes,
+                frame_tables=frame_tables,
             )
             sync_execute_read_reqs(
                 read_reqs=reqs,
@@ -1052,12 +1090,77 @@ def _is_jax_array(obj: Any) -> bool:
     return isinstance(obj, jax.Array)
 
 
+def _framed_sub_entries(entry: Entry):
+    """ArrayEntries under ``entry`` (itself, chunks, or shards) that carry a
+    framed compressed payload."""
+    subs = []
+    if isinstance(entry, ArrayEntry):
+        subs.append(entry)
+    for chunk in getattr(entry, "chunks", None) or []:
+        subs.append(chunk.tensor)
+    for shard in getattr(entry, "shards", None) or []:
+        subs.append(shard.tensor)
+    return [s for s in subs if getattr(s, "frame_bytes", None)]
+
+
+def _fetch_frame_tables(
+    entries,
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+    buffer_size_limit_bytes: Optional[int],
+) -> Dict[str, List[int]]:
+    """Read the ``.ftab`` side objects of framed compressed entries that a
+    budget will sub-read. Whole-object reads need no table (frames decode by
+    concatenation), so with no budget this is free. A missing/corrupt table
+    degrades to whole-object reads with a warning — never a failed restore."""
+    import json as _json
+
+    from .io_preparers.array import FRAME_TABLE_SUFFIX
+    from .serialization import array_nbytes
+
+    if buffer_size_limit_bytes is None:
+        return {}
+    locations: Dict[str, None] = {}  # insertion-ordered set
+    for entry in entries:
+        for sub in _framed_sub_entries(entry):
+            if array_nbytes(sub.shape, sub.dtype) > buffer_size_limit_bytes:
+                locations[sub.location] = None
+    if not locations:
+        return {}
+    tables: Dict[str, List[int]] = {}
+
+    async def fetch_all() -> None:
+        sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
+
+        async def fetch_one(loc: str) -> None:
+            async with sem:
+                read_io = ReadIO(path=loc + FRAME_TABLE_SUFFIX)
+                try:
+                    await storage.read(read_io)
+                    parsed = _json.loads(read_io.buf.getvalue().decode())
+                    tables[loc] = [int(s) for s in parsed["sizes"]]
+                except Exception:  # noqa: BLE001 - degrade, don't fail
+                    logger.warning(
+                        "frame table %s%s unreadable; falling back to a "
+                        "whole-object read",
+                        loc,
+                        FRAME_TABLE_SUFFIX,
+                        exc_info=True,
+                    )
+
+        await asyncio.gather(*(fetch_one(loc) for loc in locations))
+
+    event_loop.run_until_complete(fetch_all())
+    return tables
+
+
 def _prepare_restore_one(
     logical_path: str,
     entry: Entry,
     live: Any,
     loaded: Dict[str, Any],
     buffer_size_limit_bytes: Optional[int] = None,
+    frame_tables: Optional[Dict[str, List[int]]] = None,
 ) -> Tuple[List[ReadReq], Optional[Callable[[], None]]]:
     """Plan the reads for one entry; returns (read_reqs, finalizer).
 
@@ -1099,10 +1202,15 @@ def _prepare_restore_one(
         target = live if in_place else np.empty(tuple(entry.shape), dtype=np_dtype)
         if isinstance(entry, ChunkedArrayEntry):
             reqs = ChunkedArrayIOPreparer.prepare_read(
-                entry, target, buffer_size_limit_bytes
+                entry, target, buffer_size_limit_bytes, frame_tables=frame_tables
             )
         else:
-            reqs = ArrayIOPreparer.prepare_read(entry, target, buffer_size_limit_bytes)
+            reqs = ArrayIOPreparer.prepare_read(
+                entry,
+                target,
+                buffer_size_limit_bytes,
+                frame_table=(frame_tables or {}).get(entry.location),
+            )
         if _is_jax_array(live):
 
             def finalize_jax() -> None:
@@ -1121,7 +1229,7 @@ def _prepare_restore_one(
             buffers = alloc_target_shards(sharding, entry.shape, np_dtype)
             targets = [(buf, off, sz) for buf, off, sz in buffers.values()]
             reqs = ShardedArrayIOPreparer.prepare_read(
-                entry, targets, buffer_size_limit_bytes
+                entry, targets, buffer_size_limit_bytes, frame_tables=frame_tables
             )
 
             def finalize_sharded() -> None:
@@ -1143,6 +1251,7 @@ def _prepare_restore_one(
             entry,
             [(target, [0] * len(entry.shape), list(entry.shape))],
             buffer_size_limit_bytes,
+            frame_tables=frame_tables,
         )
         loaded[logical_path] = target
         return reqs, None

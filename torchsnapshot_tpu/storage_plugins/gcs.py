@@ -49,26 +49,18 @@ class GCSStoragePlugin(StoragePlugin):
         self._bucket = self._client.bucket(bucket_name)
         self._executor = ThreadPoolExecutor(max_workers=_IO_THREADS)
         self._progress = CollectiveProgress()
-        # One authorized HTTP session shared by all resumable uploads on
-        # this plugin (connection reuse; closed with the plugin). Lazy: most
-        # snapshots never exceed the chunk threshold.
-        self._upload_transport = None
-        self._transport_lock = threading.Lock()
 
     def _blob_path(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
-    def _get_upload_transport(self):
-        """One authorized HTTP session shared by every resumable upload on
-        this plugin (connection reuse); created on first use so fake-backed
-        tests and small-object-only workloads never import google.auth.
-        Locked: initiate() runs on executor threads, and a lost race would
-        leak the losing session's connection pool past close()."""
-        if self._upload_transport is None:
-            with self._transport_lock:
-                if self._upload_transport is None:
-                    self._upload_transport = _make_authorized_session(self._client)
-        return self._upload_transport
+    def _make_upload_transport(self):
+        """A fresh AuthorizedSession PER resumable upload. ``requests.
+        Session`` is not documented thread-safe, and concurrent large-object
+        uploads run on different executor threads — a shared session risks
+        cookie-jar/credential-refresh races (ADVICE round 2, item 2). Each
+        upload still reuses its own connection across all of its chunks,
+        which is where connection reuse actually pays."""
+        return _make_authorized_session(self._client)
 
     async def _retrying(self, fn) -> object:
         loop = asyncio.get_event_loop()
@@ -114,10 +106,19 @@ class GCSStoragePlugin(StoragePlugin):
                 self._blob_path(path),
                 mv,
                 chunk_bytes,
-                transport_factory=self._get_upload_transport,
+                transport_factory=self._make_upload_transport,
             )
 
         session = await self._retrying(initiate)
+        try:
+            await self._drive_resumable(loop, session, path)
+        finally:
+            # The per-upload transport's connection pool dies with the upload.
+            close = getattr(session, "close", None)
+            if close is not None:
+                close()
+
+    async def _drive_resumable(self, loop, session, path: str) -> None:
         attempt = 0
         stalled = 0
         while not session.finished:
@@ -239,12 +240,6 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         self._executor.shutdown(wait=True)
-        if self._upload_transport is not None:
-            try:
-                self._upload_transport.close()
-            except Exception:  # pragma: no cover - session already dead
-                pass
-            self._upload_transport = None
 
 
 class _GoogleResumableSession:
@@ -267,7 +262,9 @@ class _GoogleResumableSession:
     ) -> None:
         from google.resumable_media.requests import ResumableUpload  # type: ignore[import-not-found]
 
-        # Plugin-owned session, shared across uploads on the plugin.
+        # Per-upload session (see GCSStoragePlugin._make_upload_transport);
+        # closed by the upload loop — or right here if initiate() fails, so
+        # retried initiates can't leak one connection pool per attempt.
         self._transport = transport_factory()
         # Honor custom endpoints (emulators, private Google access) the same
         # way Blob.upload does: the base URL comes from the client's
@@ -287,13 +284,17 @@ class _GoogleResumableSession:
         quantum = 256 * 1024
         chunk_bytes = max(quantum, (chunk_bytes + quantum - 1) // quantum * quantum)
         self._upload = ResumableUpload(upload_url, chunk_bytes)
-        self._upload.initiate(
-            self._transport,
-            MemoryviewStream(mv),
-            metadata={"name": blob_name},
-            content_type="application/octet-stream",
-            total_bytes=mv.nbytes,
-        )
+        try:
+            self._upload.initiate(
+                self._transport,
+                MemoryviewStream(mv),
+                metadata={"name": blob_name},
+                content_type="application/octet-stream",
+                total_bytes=mv.nbytes,
+            )
+        except BaseException:
+            self.close()
+            raise
 
     @property
     def finished(self) -> bool:
@@ -308,6 +309,12 @@ class _GoogleResumableSession:
 
     def recover(self) -> None:
         self._upload.recover(self._transport)
+
+    def close(self) -> None:
+        try:
+            self._transport.close()
+        except Exception:  # pragma: no cover - session already dead
+            pass
 
 
 def _response_status(e: Exception):

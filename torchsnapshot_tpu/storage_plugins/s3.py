@@ -126,14 +126,35 @@ class S3StoragePlugin(StoragePlugin):
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
                 raise
-            await self._retrying(
-                lambda: client.complete_multipart_upload(
-                    Bucket=self.bucket,
-                    Key=key,
-                    UploadId=upload_id,
-                    MultipartUpload={"Parts": list(parts)},
+            try:
+                await self._retrying(
+                    lambda: client.complete_multipart_upload(
+                        Bucket=self.bucket,
+                        Key=key,
+                        UploadId=upload_id,
+                        MultipartUpload={"Parts": list(parts)},
+                    )
                 )
-            )
+            except Exception as complete_exc:
+                # S3's documented 200-with-InternalError-body case: the
+                # complete can COMMIT server-side yet surface as a transient
+                # failure, and its retry then gets NoSuchUpload (the upload
+                # id is consumed by the commit). Probe the object: present
+                # at the right size == the complete succeeded (ADVICE
+                # round 2, item 1).
+                if _error_code(complete_exc) != "NoSuchUpload":
+                    raise
+                head = await self._retrying(
+                    lambda: client.head_object(Bucket=self.bucket, Key=key)
+                )
+                if int(head.get("ContentLength", -1)) != mv.nbytes:
+                    raise
+                logger.info(
+                    "multipart complete for %s reported NoSuchUpload but the "
+                    "object exists at the expected size; treating the upload "
+                    "as committed",
+                    key,
+                )
         except BaseException:
             try:
                 # The abort gets the same transient-retry treatment as any
@@ -145,15 +166,20 @@ class S3StoragePlugin(StoragePlugin):
                         Bucket=self.bucket, Key=key, UploadId=upload_id
                     )
                 )
-            except Exception:
-                logger.warning(
-                    "Failed to abort multipart upload %s for %s; orphaned "
-                    "parts may accrue storage until a bucket lifecycle rule "
-                    "cleans them",
-                    upload_id,
-                    key,
-                    exc_info=True,
-                )
+            except Exception as abort_exc:
+                if _error_code(abort_exc) == "NoSuchUpload":
+                    # Upload id already consumed (committed or cleaned up
+                    # server-side): nothing orphaned, nothing to warn about.
+                    pass
+                else:
+                    logger.warning(
+                        "Failed to abort multipart upload %s for %s; orphaned "
+                        "parts may accrue storage until a bucket lifecycle "
+                        "rule cleans them",
+                        upload_id,
+                        key,
+                        exc_info=True,
+                    )
             raise
 
     async def read(self, read_io: ReadIO) -> None:
@@ -226,14 +252,18 @@ class S3StoragePlugin(StoragePlugin):
             self._client_ctx = None
 
 
+def _error_code(e: Exception):
+    """The structured botocore error code of ``e``, or None."""
+    resp = getattr(e, "response", None)
+    if isinstance(resp, dict):
+        return resp.get("Error", {}).get("Code")
+    return None
+
+
 def _is_no_such_key(e: Exception) -> bool:
     """Backend absence, normalized per the StoragePlugin contract. Reads the
     structured botocore error code, not exception names/messages."""
-    code = getattr(e, "response", None)
-    if isinstance(code, dict):
-        code = code.get("Error", {}).get("Code")
-        return code in ("NoSuchKey", "NotFound", "404")
-    return False
+    return _error_code(e) in ("NoSuchKey", "NotFound", "404")
 
 
 _TRANSIENT_S3_CODES = frozenset(

@@ -132,6 +132,7 @@ def compute_fingerprint(
         knobs.is_batching_enabled(),
         knobs.get_compression(),
         knobs.get_compression_level(),
+        knobs.get_compression_frame_bytes(),
         knobs.is_checksums_enabled(),
         knobs.is_dedup_digests_enabled(),
     )
