@@ -200,3 +200,32 @@ def test_sharded_cache_hit_bit_exact(tmp_path) -> None:
         init_jax_distributed=True,
         args=(str(tmp_path),),
     )
+
+
+def _worker_async_take_cache_hit(rank, world_size, shared):
+    """async_take shares the plan path: the second async take of an
+    identical structure must hit (no all_gathers in the stall window) and
+    the background commit must still produce a complete, correct snapshot."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    coord, counts = _counting_coordinator()
+    app = {"s": StateDict(w=np.full((8,), rank, dtype=np.float32), step=0)}
+    Snapshot.async_take(os.path.join(shared, "a0"), app).wait()
+    for k in counts:
+        counts[k] = 0
+    app["s"]["step"] = 5
+    pending = Snapshot.async_take(os.path.join(shared, "a1"), app)
+    stall_counts = dict(counts)
+    snap = pending.wait()
+    assert stall_counts["all_gather"] == 0, stall_counts
+    assert snap.verify() == {}
+    tgt = {"s": StateDict(w=np.zeros(8, dtype=np.float32), step=-1)}
+    snap.restore(tgt)
+    assert tgt["s"]["step"] == 5
+    assert np.array_equal(tgt["s"]["w"], np.full((8,), rank, dtype=np.float32))
+
+
+def test_async_take_cache_hit(tmp_path) -> None:
+    run_with_processes(
+        _worker_async_take_cache_hit, nproc=2, args=(str(tmp_path),)
+    )

@@ -471,19 +471,18 @@ def batch_read_requests(
     than the cap still passes through whole (the usual one-over-budget
     escape hatch).
     """
-    from .io_preparers.array import FramedSliceConsumer
-
     ranged: Dict[str, List[ReadReq]] = {}
     passthrough: List[ReadReq] = []
     for req in read_reqs:
-        if req.byte_range is None or isinstance(
-            req.buffer_consumer, FramedSliceConsumer
+        if req.byte_range is None or getattr(
+            req.buffer_consumer, "merge_exempt", False
         ):
             # Framed sub-reads are already budget-sized in RAW terms; their
             # COMPRESSED ranges are exactly adjacent, so merging them by the
             # compressed-span cap would coalesce up to compression-ratio
             # many groups and decode far more raw bytes than the budget —
             # the whole-object RSS spike framing exists to prevent.
+            # (Attribute, not isinstance: wrappers proxy it.)
             passthrough.append(req)
         else:
             ranged.setdefault(req.path, []).append(req)

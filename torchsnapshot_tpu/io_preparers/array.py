@@ -263,6 +263,12 @@ class FramedSliceConsumer(BufferConsumer):
     frames may cover a superset (frame alignment), which is sliced off.
     """
 
+    # Read-merging must never coalesce framed groups: their COMPRESSED
+    # ranges are adjacent, so a compressed-span cap would re-create the
+    # whole-object decode the budget split exists to avoid. Checked (via
+    # any wrapper's proxy) by ``batcher.batch_read_requests``.
+    merge_exempt = True
+
     def __init__(
         self,
         serializer: str,

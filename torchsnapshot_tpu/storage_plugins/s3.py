@@ -85,9 +85,12 @@ class S3StoragePlugin(StoragePlugin):
         """Chunked upload with per-part retry: a transient fault re-sends at
         most the interrupted part. Aborts the upload on permanent failure so
         S3 doesn't bill for orphaned parts forever."""
+        import time as _time
+
         client = await self._get_client()
         key = self._key(path)
         chunk = knobs.get_s3_chunk_bytes()
+        upload_started_at = _time.time()
         created = await self._retrying(
             lambda: client.create_multipart_upload(Bucket=self.bucket, Key=key)
         )
@@ -149,10 +152,24 @@ class S3StoragePlugin(StoragePlugin):
                 )
                 if int(head.get("ContentLength", -1)) != mv.nbytes:
                     raise
+                # Size alone can't distinguish THIS upload's commit from a
+                # stale same-key object of an earlier take (raw payload
+                # sizes are pure functions of shape+dtype): also require
+                # the object to be newer than this upload's start. SigV4
+                # already bounds client/S3 clock skew to 15 minutes, so a
+                # 15-minute tolerance is principled, not arbitrary.
+                modified = head.get("LastModified")
+                modified_ts = (
+                    modified.timestamp() if modified is not None else None
+                )
+                if modified_ts is not None and modified_ts < (
+                    upload_started_at - 900
+                ):
+                    raise
                 logger.info(
                     "multipart complete for %s reported NoSuchUpload but the "
-                    "object exists at the expected size; treating the upload "
-                    "as committed",
+                    "object exists at the expected size and mtime; treating "
+                    "the upload as committed",
                     key,
                 )
         except BaseException:
