@@ -69,8 +69,21 @@ def test_random_state_roundtrip(tmp_path, seed) -> None:
         codec = ("none", "zstd", "zlib")[seed % 3]
         if codec != "none":
             stack.enter_context(knobs.override_compression(codec))
+            # Tiny frame size: most compressed arrays become FRAMED (with
+            # .ftab side objects), fuzzing framing x batching x chunking.
+            stack.enter_context(knobs.override_compression_frame_bytes(48))
         Snapshot.take(path, {"s": sd})
     out = StateDict()
     Snapshot(path).restore({"s": out})
     assert_state_dict_eq(dict(out), expected, exact=True)
     assert Snapshot(path).verify() == {}
+    # Budgeted random access of one array leaf (framed sub-read path when
+    # the codec framed it).
+    array_keys = [k for k, v in expected.items() if isinstance(v, np.ndarray)]
+    if array_keys:
+        k = array_keys[int(rng.integers(0, len(array_keys)))]
+        got = Snapshot(path).read_object(f"0/s/{k}", memory_budget_bytes=64)
+        assert np.array_equal(
+            np.asarray(got).reshape(-1).view(np.uint8),
+            expected[k].reshape(-1).view(np.uint8),
+        ), k

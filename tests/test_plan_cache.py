@@ -229,3 +229,29 @@ def test_async_take_cache_hit(tmp_path) -> None:
     run_with_processes(
         _worker_async_take_cache_hit, nproc=2, args=(str(tmp_path),)
     )
+
+
+def _worker_knob_change_forces_miss(rank, world_size, shared):
+    """Plan-shaping knobs are in the fingerprint: flipping the compression
+    codec between takes must miss (a cached partition assignment computed
+    under different serializers must never be replayed)."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs
+
+    coord, counts = _counting_coordinator()
+    app = {"s": StateDict(w=np.arange(64, dtype=np.float32))}
+    Snapshot.take(os.path.join(shared, "c0"), app)
+    for k in counts:
+        counts[k] = 0
+    with knobs.override_compression("zstd"):
+        Snapshot.take(os.path.join(shared, "c1"), app)
+    assert counts["all_gather"] >= 1, counts  # full path ran
+    tgt = {"s": StateDict(w=np.zeros(64, dtype=np.float32))}
+    Snapshot(os.path.join(shared, "c1")).restore(tgt)
+    assert np.array_equal(tgt["s"]["w"], np.arange(64, dtype=np.float32))
+
+
+def test_knob_change_forces_miss(tmp_path) -> None:
+    run_with_processes(
+        _worker_knob_change_forces_miss, nproc=2, args=(str(tmp_path),)
+    )
