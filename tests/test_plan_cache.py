@@ -205,19 +205,32 @@ def test_sharded_cache_hit_bit_exact(tmp_path) -> None:
 def _worker_async_take_cache_hit(rank, world_size, shared):
     """async_take shares the plan path: the second async take of an
     identical structure must hit (no all_gathers in the stall window) and
-    the background commit must still produce a complete, correct snapshot."""
+    the background commit must still produce a complete, correct snapshot.
+    Also pins the published coordination claim: a steady-state stall costs
+    a non-zero rank exactly 3 store round-trips (preflight set + decision
+    get + manifest-delta set)."""
     from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.parallel import store as store_mod
 
     coord, counts = _counting_coordinator()
     app = {"s": StateDict(w=np.full((8,), rank, dtype=np.float32), step=0)}
     Snapshot.async_take(os.path.join(shared, "a0"), app).wait()
     for k in counts:
         counts[k] = 0
+    store_mod.reset_op_counts()
     app["s"]["step"] = 5
     pending = Snapshot.async_take(os.path.join(shared, "a1"), app)
     stall_counts = dict(counts)
+    stall_ops = sum(
+        store_mod.get_op_counts(current_thread_only=True).values()
+    )
     snap = pending.wait()
     assert stall_counts["all_gather"] == 0, stall_counts
+    if rank != 0:
+        assert stall_ops == 3, stall_ops
+    else:
+        # Rank 0 additionally reads every rank's gather keys: 2W + 3.
+        assert stall_ops == 2 * world_size + 3, stall_ops
     assert snap.verify() == {}
     tgt = {"s": StateDict(w=np.zeros(8, dtype=np.float32), step=-1)}
     snap.restore(tgt)
