@@ -87,6 +87,16 @@ class ReadReq:
 class WriteIO:
     path: str
     buf: BufferType
+    # want_digest: set by the caller when it will consume digest_out —
+    # plugins that can compute the object digest inside their write path
+    # ([crc32, size, sha256-hex | None], the sidecar format) then fill
+    # digest_out; the native FS engine hashes chunk-by-chunk while the data
+    # is cache-hot, sparing the scheduler's Python hashing pass its full
+    # extra memory sweep. Writes whose caller hashes elsewhere (incremental
+    # takes pre-hash for dedup; sidecar files) leave want_digest False so
+    # no plugin wastes a pass. digest_out None = not computed.
+    want_digest: bool = False
+    digest_out: Optional[list] = None
 
 
 @dataclass

@@ -270,10 +270,6 @@ class _WritePipeline:
                 self._crc_executor = ThreadPoolExecutor(
                     max_workers=knobs.get_staging_threads()
                 )
-            digest = await loop.run_in_executor(
-                self._crc_executor, _digest_buffer, memoryview(buf)
-            )
-            self.checksums[path] = digest
             if not self._base_resolved:
                 async with self._base_lock:
                     if not self._base_resolved:
@@ -296,7 +292,39 @@ class _WritePipeline:
                             }
                             self.base = (root, digests, by_content)
                         self._base_resolved = True
-            if self.base is not None and digest[2] is not None:
+            if self.base is None:
+                # No incremental base: nothing needs the digest BEFORE the
+                # write, so let the plugin compute the crc inside its own
+                # write loop (the native FS engine hashes chunk-hot in C++
+                # — WriteIO.digest_out) and only hash in Python what the
+                # plugin didn't cover: everything (non-native backends), or
+                # just the sha256 dedup digest.
+                write_io = WriteIO(path=path, buf=buf, want_digest=True)
+                await self.storage.write(write_io)
+                digest = write_io.digest_out
+                if digest is None:
+                    digest = await loop.run_in_executor(
+                        self._crc_executor, _digest_buffer, memoryview(buf)
+                    )
+                elif digest[2] is None and knobs.is_dedup_digests_enabled():
+
+                    def sha_only(mv=memoryview(buf)):
+                        h = hashlib.sha256()
+                        h.update(mv)
+                        return h.hexdigest()
+
+                    digest = [
+                        digest[0],
+                        digest[1],
+                        await loop.run_in_executor(self._crc_executor, sha_only),
+                    ]
+                self.checksums[path] = digest
+                return
+            digest = await loop.run_in_executor(
+                self._crc_executor, _digest_buffer, memoryview(buf)
+            )
+            self.checksums[path] = digest
+            if digest[2] is not None:
                 base_root, base_digests, by_content = self.base
                 rec = base_digests.get(path)
                 src_path = None

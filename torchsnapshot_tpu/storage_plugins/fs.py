@@ -92,9 +92,26 @@ class FSStoragePlugin(StoragePlugin):
             nbytes = memoryview(write_io.buf).nbytes
             if self._use_native(nbytes):
                 lib = self._native
+                # The crc digest rides the write loop (chunk-hot hashing in
+                # C++) when the CALLER asked for it; the scheduler uses
+                # digest_out instead of a second full pass over the buffer
+                # (and fills the sha256 slot itself if dedup digests are on
+                # — hashlib's OpenSSL sha is the fast one).
+                want_digest = write_io.want_digest
 
                 def work() -> None:
                     with self._get_direct_sem():
+                        if want_digest:
+                            digest = native.write_file_digest(
+                                lib,
+                                tmp_path,
+                                write_io.buf,
+                                direct=True,
+                                chunk_bytes=knobs.get_direct_io_chunk_bytes(),
+                            )
+                            if digest is not None:
+                                write_io.digest_out = digest
+                                return
                         native.write_file(
                             lib,
                             tmp_path,
