@@ -41,9 +41,9 @@ Correctness notes:
   the delta manifest gather, which diffs *entry dicts* (so even entries that
   change for reasons outside the fingerprint — e.g. relocated slab paths —
   are re-gathered correctly).
-- ``have_cached_plan`` also reflects the local knob, so disabling
-  ``TORCHSNAPSHOT_TPU_PLAN_CACHE`` on any one rank safely forces a global
-  MISS (never a deadlock).
+- ``plan_token`` (None when the rank holds no plan) also reflects the local
+  knob, so disabling ``TORCHSNAPSHOT_TPU_PLAN_CACHE`` on any one rank
+  safely forces a global MISS (never a deadlock).
 - World size 1 runs no collectives at all; the cache is bypassed (there is
   nothing to save).
 """
