@@ -487,3 +487,42 @@ def test_compressed_sharded_reshard(tmp_path) -> None:
         Snapshot(path).restore({"s": tgt})
         got = np.asarray(tgt["x"])
         assert got.view(np.uint8).tobytes() == host.view(np.uint8).tobytes(), spec
+
+
+def test_frame_table_stager_fails_fast_when_payload_staging_fails(monkeypatch) -> None:
+    """A framed payload's staging failure must unblock the companion .ftab
+    stager promptly (RuntimeError), not leave it polling forever as an
+    orphaned task."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_preparers import array as array_mod
+    from torchsnapshot_tpu.io_preparers.array import (
+        ArrayBufferStager,
+        FrameTableStager,
+    )
+    from torchsnapshot_tpu.manifest import ArrayEntry
+
+    entry = ArrayEntry(
+        location="p",
+        serializer=Serializer.RAW_ZSTD,
+        dtype="float32",
+        shape=[1024],
+        frame_bytes=512,
+    )
+    with knobs.override_compression("zstd"):
+        main = ArrayBufferStager(np.arange(1024, dtype=np.float32), entry)
+    ftab = FrameTableStager(main)
+
+    def boom(*args, **kwargs):
+        raise MemoryError("compressor OOM")
+
+    monkeypatch.setattr(array_mod, "compress_framed", boom)
+
+    async def go():
+        ftab_task = asyncio.ensure_future(ftab.stage_buffer())
+        with pytest.raises(MemoryError):
+            await main.stage_buffer()
+        with pytest.raises(RuntimeError, match="payload staging failed"):
+            await asyncio.wait_for(ftab_task, timeout=5)
+
+    asyncio.new_event_loop().run_until_complete(go())
