@@ -31,9 +31,10 @@ traffic**:
    per-rank **delta** against the previous take's entries (typically just
    the step counter and other inline primitives).
 
-A rank whose structure changed reports a different fingerprint, rank 0
-broadcasts MISS, and every rank runs the full path — ranks can never diverge
-on which collectives they issue, because the decision itself is a collective.
+A rank whose structure changed finds no cached plan under its new
+fingerprint and reports ``plan_token=None``; rank 0 broadcasts MISS and
+every rank runs the full path — ranks can never diverge on which
+collectives they issue, because the decision itself is a collective.
 
 Correctness notes:
 
