@@ -268,3 +268,37 @@ def test_knob_change_forces_miss(tmp_path) -> None:
     run_with_processes(
         _worker_knob_change_forces_miss, nproc=2, args=(str(tmp_path),)
     )
+
+
+def _worker_cache_hit_composes_with_incremental(rank, world_size, shared):
+    """The two flagship cost-cutters together: a steady-state (cache-HIT)
+    take with base=prev must still dedup unchanged objects via hard links
+    and restore the changed ones correctly — base rides the preflight
+    broadcast, dedup rides the write pipeline."""
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    coord, counts = _counting_coordinator()
+    frozen = np.arange(4096, dtype=np.float32) + rank
+    p0 = os.path.join(shared, "c0")
+    p1 = os.path.join(shared, "c1")
+    Snapshot.take(p0, {"m": StateDict(frozen=frozen, step=0)})
+    for k in counts:
+        counts[k] = 0
+    Snapshot.take(p1, {"m": StateDict(frozen=frozen, step=1)}, base=p0)
+    assert counts["all_gather"] == 0, counts  # the take HIT the plan cache
+    # The frozen array deduped: same inode as the base's object.
+    a = os.path.join(p0, str(rank), "m", "frozen")
+    b = os.path.join(p1, str(rank), "m", "frozen")
+    assert os.path.samefile(a, b), (a, b)
+    tgt = {"m": StateDict(frozen=np.zeros(4096, dtype=np.float32), step=-1)}
+    Snapshot(p1).restore(tgt)
+    assert tgt["m"]["step"] == 1
+    assert np.array_equal(tgt["m"]["frozen"], frozen)
+
+
+def test_cache_hit_composes_with_incremental(tmp_path) -> None:
+    run_with_processes(
+        _worker_cache_hit_composes_with_incremental,
+        nproc=2,
+        args=(str(tmp_path),),
+    )
