@@ -22,6 +22,7 @@ chunks for transfer/I-O pipelining.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -40,7 +41,10 @@ from .io_preparers.array import ArrayIOPreparer
 from .io_preparers.chunked_array import ChunkedArrayIOPreparer, should_chunk
 from .io_preparers.object import ObjectIOPreparer
 from .io_preparers.sharded_array import ShardedArrayIOPreparer
+from .utils import knobs
 from .utils.lru import BoundedLRU
+
+logger = logging.getLogger(__name__)
 
 
 def get_storage_path(logical_path: str, rank: int, replicated: bool) -> str:
@@ -62,11 +66,63 @@ def _globally_replicated(arr: Any, world_size: int) -> bool:
     return len(procs) == world_size and world_size > 1
 
 
+class _HostShard:
+    """Mimics ``jax.Shard`` for host-captured data: the planning-visible
+    metadata (index/replica_id/device) with the data already in host RAM."""
+
+    __slots__ = ("index", "replica_id", "device", "data")
+
+    def __init__(self, index: Any, replica_id: int, device: Any, data: np.ndarray) -> None:
+        self.index = index
+        self.replica_id = replica_id
+        self.device = device
+        self.data = data
+
+
+class HostCapturedArray:
+    """A donation-safe *host* capture of a ``jax.Array``.
+
+    Produced by the degraded async-fork path when HBM can't hold an
+    on-device defensive copy (the reference's host-capture semantics,
+    ``io_preparers/tensor.py:254-278`` — which always work, at the cost of a
+    blocking D2H inside the take stall). Preserves exactly the metadata the
+    write planners read — ``shape``/``dtype``/``sharding``/
+    ``addressable_shards`` with per-shard ``index``/``replica_id`` — so the
+    resulting plan (entries, shard locations, partition assignment) is
+    byte-identical to the device-forked plan; only the stagers' data source
+    differs (private host buffers instead of forked device buffers).
+    """
+
+    def __init__(self, shape: Tuple[int, ...], dtype: Any, sharding: Any, shards: List[_HostShard]) -> None:
+        self.shape = shape
+        self.dtype = dtype
+        self.sharding = sharding
+        self.addressable_shards = shards
+
+    def assembled_local(self) -> np.ndarray:
+        """The full local value (what ``np.asarray`` yields for the original
+        array): shard 0 when one shard covers the array, else the shards
+        scattered into a host buffer (a per-rank array sharded across
+        multiple *local* devices classifies as "array" and stages whole)."""
+        shards = self.addressable_shards
+        if len(shards) == 1 or self.sharding.is_fully_replicated:
+            return shards[0].data
+        out = np.empty(self.shape, dtype=self.dtype)
+        for s in shards:
+            out[s.index] = s.data
+        return out
+
+
+def _is_plannable_array(value: Any) -> bool:
+    """jax.Array, or a host capture carrying the same planning metadata."""
+    return _is_jax_array(value) or isinstance(value, HostCapturedArray)
+
+
 def classify(value: Any, world_size: int) -> str:
     """One of: primitive | sharded | replicated_array | array | object."""
     if isinstance(value, PRIMITIVE_TYPES) and not isinstance(value, np.generic):
         return "primitive"
-    if _is_jax_array(value):
+    if _is_plannable_array(value):
         if _globally_replicated(value, world_size):
             return "replicated_array"
         procs = {d.process_index for d in value.sharding.device_set}
@@ -111,17 +167,148 @@ def _defensive_device_copies(arrs: List[Any]) -> List[Any]:
     assignment, so leaves are grouped by assignment first (params on the
     full mesh vs. a step counter committed to one device vs. host-offloaded
     state); each group compiles and dispatches once.
+
+    **HBM-pressure degradation** (the availability guarantee): exactly when
+    checkpointing matters most — model + optimizer near HBM capacity — the
+    full-state copy may not fit. An allocation failure
+    (``RESOURCE_EXHAUSTED``) from a group's fork degrades that group by
+    bisection: sub-groups whose fork still fits stay device-forked (their
+    D2H drains asynchronously in the background as usual), and leaves whose
+    fork fails even alone are captured *through host RAM, blocking, from
+    the original buffers* — zero HBM overhead, donation-safe because it
+    completes before ``async_take`` returns. This is the reference's
+    host-capture design (``io_preparers/tensor.py:254-278``), applied only
+    to the residual that doesn't fit, so ``async_take`` is never less
+    available than the reference: the HBM overhead is bounded by what
+    actually fit (by construction), and only the host-captured bytes extend
+    the stall (a warning reports both).
     """
     groups: Dict[Any, List[int]] = {}
     for i, a in enumerate(arrs):
         groups.setdefault(_device_assignment_key(a.sharding), []).append(i)
     out: List[Any] = [None] * len(arrs)
+    # Cumulative successfully-forked local bytes across this take, for the
+    # simulated-HBM-limit knob (mirrors real accounting: forks accumulate).
+    forked_bytes = [0]
+    captured: List[Any] = []  # host-captured leaves, for the warning
     for indices in groups.values():
         group = [arrs[i] for i in indices]
-        copies = _batch_copy_fn(tuple(a.sharding for a in group))(group)
+        copies = _fork_or_capture(group, forked_bytes, captured)
         for i, c in zip(indices, copies):
             out[i] = c
+    if captured:
+        total = sum(_local_fork_nbytes(a) for a in captured)
+        logger.warning(
+            "async_take defensive fork hit HBM pressure: %d of %d leaves "
+            "(%.3f GB) were captured through host RAM instead (blocking "
+            "D2H inside the take stall; device-forked leaves still drain "
+            "in the background). The snapshot remains donation-safe.",
+            len(captured),
+            len(arrs),
+            total / 1e9,
+        )
     return out
+
+
+def _local_fork_nbytes(arr: Any) -> int:
+    """HBM bytes a defensive fork of ``arr`` allocates on this process."""
+    return sum(int(s.data.nbytes) for s in arr.addressable_shards)
+
+
+def _is_oom_error(e: BaseException) -> bool:
+    s = str(e)
+    return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
+
+
+def _try_fork(group: List[Any], forked_bytes: List[int]) -> List[Any]:
+    """One batched jitted copy of ``group``; raises on allocation failure.
+
+    PJRT allocates output buffers synchronously at dispatch, so a real
+    ``RESOURCE_EXHAUSTED`` surfaces from this call without blocking on the
+    copy itself. The knob simulates the same failure for tests/tiny-HBM."""
+    limit = knobs.get_async_fork_hbm_limit_bytes()
+    if limit is not None:
+        need = sum(_local_fork_nbytes(a) for a in group)
+        if forked_bytes[0] + need > limit:
+            raise RuntimeError(
+                f"RESOURCE_EXHAUSTED: simulated HBM limit "
+                f"({forked_bytes[0]} + {need} > {limit} bytes)"
+            )
+    copies = _batch_copy_fn(tuple(a.sharding for a in group))(group)
+    if limit is not None:
+        # Accounting feeds only the simulated limit; skip the per-shard
+        # walk on the production hot path.
+        forked_bytes[0] += need
+    return copies
+
+
+# Bisection depth bound for the degraded fork: each distinct sub-group is a
+# fresh XLA program whose compile runs inside the (already degraded) stall,
+# so recursion stops at quarters — at most 6 extra compiles per failing
+# group, reused across takes via the _BATCH_COPIES LRU. Anything a quarter
+# group can't fit is host-captured without further compile attempts. (The
+# simulated-limit knob raises before compiling, so tests pay nothing.)
+_MAX_FORK_BISECT_DEPTH = 2
+
+
+def _fork_or_capture(
+    group: List[Any], forked_bytes: List[int], captured: List[Any], depth: int = 0
+) -> List[Any]:
+    """Fork the group; on allocation failure bisect so what fits stays
+    device-forked and the rest is host-captured (see
+    ``_defensive_device_copies``)."""
+    try:
+        return _try_fork(group, forked_bytes)
+    except Exception as e:  # noqa: BLE001 - only OOM degrades
+        if not _is_oom_error(e):
+            raise
+    if len(group) == 1 or depth >= _MAX_FORK_BISECT_DEPTH:
+        captured.extend(group)
+        return _host_capture_group(group)
+    mid = len(group) // 2
+    return _fork_or_capture(
+        group[:mid], forked_bytes, captured, depth + 1
+    ) + _fork_or_capture(group[mid:], forked_bytes, captured, depth + 1)
+
+
+def _host_capture_group(group: List[Any]) -> List[HostCapturedArray]:
+    """Blocking host capture of a group of arrays: async D2H hints for EVERY
+    shard of EVERY array first, so the per-shard resolves pipeline on the
+    transfer engine instead of serializing array by array."""
+    for a in group:
+        for s in a.addressable_shards:
+            try:
+                s.data.copy_to_host_async()
+            except Exception:  # pragma: no cover - platform-specific hint
+                pass
+    return [_host_capture(a) for a in group]
+
+
+def _aliases_device_buffer(shard_data: Any) -> bool:
+    """Whether ``np.asarray(shard_data)`` may alias the XLA buffer (which
+    donation would then free under the stager). A TPU device-memory D2H
+    result is always a private host copy; CPU-backed and host-offloaded
+    arrays can be zero-copy views — and jax returns its cached ``np.asarray``
+    read-only with ``base=None`` on every backend, so the numpy flags can't
+    distinguish the two."""
+    try:
+        if next(iter(shard_data.devices())).platform == "cpu":
+            return True
+        return shard_data.sharding.memory_kind not in (None, "device")
+    except Exception:  # pragma: no cover - be safe on exotic platforms
+        return True
+
+
+def _host_capture(arr: Any) -> HostCapturedArray:
+    host_shards = []
+    for s in arr.addressable_shards:
+        data = np.asarray(s.data)
+        if _aliases_device_buffer(s.data):
+            data = data.copy()
+        host_shards.append(_HostShard(s.index, s.replica_id, s.device, data))
+    return HostCapturedArray(
+        tuple(int(d) for d in arr.shape), np.dtype(arr.dtype), arr.sharding, host_shards
+    )
 
 
 def _device_assignment_key(sharding) -> Any:
@@ -167,18 +354,21 @@ def prepare_write(
         # defer_staging=False and is captured (staged under the budget)
         # before async_take returns — the reference's semantics
         # (``scheduler.py:178-214``).
-        from .utils import knobs
-
         device_paths = [p for p, v in flattened.items() if _is_jax_array(v)]
         if device_paths and knobs.is_async_device_copy_enabled():
             copies = _defensive_device_copies([flattened[p] for p in device_paths])
             flattened = dict(flattened)
             flattened.update(zip(device_paths, copies))
-    device_paths_set = {p for p, v in flattened.items() if _is_jax_array(v)}
+    device_paths_set = {p for p, v in flattened.items() if _is_plannable_array(v)}
     for logical_path, value in flattened.items():
         is_device_value = logical_path in device_paths_set
         kind = classify(value, world_size)
         glob_replicated = logical_path in replicated_paths
+        # Host-captured leaves already hold private host buffers: their
+        # stagers must not re-copy (is_async_snapshot=False below), but
+        # their staging still defers past async_take's return like any
+        # other immutable capture.
+        is_captured = isinstance(value, HostCapturedArray)
 
         if kind == "primitive":
             manifest[logical_path] = PrimitiveEntry.from_value(
@@ -188,7 +378,9 @@ def prepare_write(
 
         if kind == "sharded":
             entry, reqs = ShardedArrayIOPreparer.prepare_write(
-                logical_path, value, is_async_snapshot=is_async_snapshot
+                logical_path,
+                value,
+                is_async_snapshot=is_async_snapshot and not is_captured,
             )
             manifest[logical_path] = entry
             if is_async_snapshot:
@@ -200,7 +392,9 @@ def prepare_write(
         if kind in ("replicated_array", "array"):
             replicated = kind == "replicated_array" or glob_replicated
             arr = value
-            if (
+            if is_captured:
+                arr = arr.assembled_local()
+            elif (
                 _is_jax_array(arr)
                 and len(arr.sharding.device_set) > 1
                 and arr.sharding.is_fully_replicated
@@ -210,11 +404,11 @@ def prepare_write(
             storage_path = get_storage_path(logical_path, rank, replicated)
             if should_chunk(arr):
                 entry, reqs = ChunkedArrayIOPreparer.prepare_write(
-                    storage_path, arr, replicated, is_async_snapshot
+                    storage_path, arr, replicated, is_async_snapshot and not is_captured
                 )
             else:
                 entry, reqs = ArrayIOPreparer.prepare_write(
-                    storage_path, arr, replicated, is_async_snapshot
+                    storage_path, arr, replicated, is_async_snapshot and not is_captured
                 )
             manifest[logical_path] = entry
             if is_async_snapshot and is_device_value:

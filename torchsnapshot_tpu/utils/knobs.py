@@ -79,6 +79,26 @@ def is_async_device_copy_enabled() -> bool:
     return os.environ.get(_ENV_ASYNC_DEVICE_COPY, "1") not in ("0", "false", "False")
 
 
+_ENV_ASYNC_FORK_HBM_LIMIT = "TORCHSNAPSHOT_TPU_ASYNC_FORK_HBM_LIMIT_BYTES"
+
+
+def get_async_fork_hbm_limit_bytes() -> Optional[int]:
+    """Simulated free-HBM cap for the async defensive fork.
+
+    When set, ``io_preparer._defensive_device_copies`` treats any fork that
+    would bring the take's cumulative forked bytes above this limit as an
+    allocation failure, exercising the degraded capture path (device-fork
+    what fits, blocking host capture for the rest) without real HBM
+    pressure. Unset (the default) on real hardware: actual XLA
+    RESOURCE_EXHAUSTED errors trigger the same degradation."""
+    val = os.environ.get(_ENV_ASYNC_FORK_HBM_LIMIT)
+    return int(val) if val is not None else None
+
+
+def override_async_fork_hbm_limit_bytes(value: int):
+    return _override_env(_ENV_ASYNC_FORK_HBM_LIMIT, str(value))
+
+
 def is_async_eager_d2h_enabled() -> bool:
     """Start D2H DMAs at ``async_take`` capture time.
 
