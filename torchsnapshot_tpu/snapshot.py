@@ -233,8 +233,8 @@ class Snapshot:
         from .take_plan import (
             TakePlan,
             compute_fingerprint,
-            get_plan_cache,
             preflight,
+            probe_plan,
         )
 
         phases: Dict[str, float] = {}
@@ -277,7 +277,7 @@ class Snapshot:
             fingerprint = compute_fingerprint(
                 flattened, coord.get_world_size(), replicated
             )
-            cached = get_plan_cache(coord).get(fingerprint)
+            cached = probe_plan(coord, fingerprint)
         else:
             fingerprint = ""
             cached = None

@@ -68,12 +68,6 @@ logger = logging.getLogger(__name__)
 # in-process caches from an older scheme must never satisfy a new build.
 _FINGERPRINT_VERSION = 1
 
-# Per-coordinator bound on retained plans (a loop alternating a few distinct
-# app-state structures — e.g. model-only vs full-state checkpoints — keeps
-# hitting; an unbounded cache would leak manifests for abandoned structures).
-_MAX_CACHED_PLANS = 4
-
-
 def _is_jax_array(obj: Any) -> bool:
     import jax
 
@@ -205,11 +199,27 @@ def get_plan_cache(coord: Coordinator) -> "Dict[str, CachedPlan]":
     return cache
 
 
+def probe_plan(coord: Coordinator, fingerprint: str) -> Optional[CachedPlan]:
+    """Look up a cached plan AND refresh its recency (dict insertion order is
+    the LRU order). Without the refresh, a loop alternating more structures
+    than the bound — or a few cold structures passing through — would evict
+    the steadily-hit plan and the cache would silently stop helping."""
+    cache = get_plan_cache(coord)
+    plan = cache.pop(fingerprint, None)
+    if plan is not None:
+        cache[fingerprint] = plan
+    return plan
+
+
 def store_plan(coord: Coordinator, fingerprint: str, plan: CachedPlan) -> None:
+    """Insert/refresh a plan; bound per knobs.get_plan_cache_size (LRU —
+    insertion order IS the recency order, maintained here and by
+    probe_plan)."""
     cache = get_plan_cache(coord)
     cache.pop(fingerprint, None)
     cache[fingerprint] = plan
-    while len(cache) > _MAX_CACHED_PLANS:
+    bound = knobs.get_plan_cache_size()
+    while len(cache) > bound:
         cache.pop(next(iter(cache)))
 
 

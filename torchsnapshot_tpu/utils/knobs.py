@@ -346,6 +346,22 @@ def override_plan_cache(enabled: bool):
     return _override_env(_ENV_PLAN_CACHE, "1" if enabled else "0")
 
 
+_ENV_PLAN_CACHE_SIZE = "TORCHSNAPSHOT_TPU_PLAN_CACHE_SIZE"
+
+
+def get_plan_cache_size() -> int:
+    """Max distinct app-state structures whose take plans are retained per
+    process (LRU; probes refresh recency). Each cached plan holds the
+    previous take's entry dicts (the manifest-delta baseline), so the bound
+    trades memory against hit rate for jobs alternating many checkpoint
+    structures."""
+    return max(1, _get_int(_ENV_PLAN_CACHE_SIZE, 4))
+
+
+def override_plan_cache_size(value: int):
+    return _override_env(_ENV_PLAN_CACHE_SIZE, str(value))
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
