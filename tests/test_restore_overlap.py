@@ -1,0 +1,169 @@
+"""Overlapped, budget-bounded restore (VERDICT round 3, item 2).
+
+Each entry's finalizer (its host → device transfer) runs inline on the
+event-loop thread — which IS the main thread — the moment the entry's last
+read has been consumed, and host buffers are released eagerly. These tests
+pin the three properties that design claims: H2D overlaps storage reads
+still in flight, jax dispatch stays on the main thread, and restore peak
+transient RSS tracks the memory budget — not the state size. The overlap
+is knob-gated (`TORCHSNAPSHOT_TPU_RESTORE_OVERLAP`, auto = multi-core
+only), so tests force it explicitly.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.io_types import ReadIO
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.utils import knobs
+
+
+class SlowReadFSStoragePlugin(FSStoragePlugin):
+    """Delays every data read and records completion times."""
+
+    delay_s = 0.2
+    read_done_times: list = []
+
+    async def read(self, read_io: ReadIO) -> None:
+        is_data = not read_io.path.startswith(".snapshot")
+        if is_data:
+            await asyncio.sleep(type(self).delay_s)
+        await super().read(read_io)
+        if is_data:
+            type(self).read_done_times.append(time.monotonic())
+
+
+def test_finalizers_overlap_reads_and_run_on_main_thread(
+    tmp_path, monkeypatch
+) -> None:
+    """With serialized slow reads, the first entry's H2D finalize must run
+    (on the main thread) well before the LAST read completes — the old
+    phase-split design finalized only after the whole pipeline."""
+    import jax
+    import jax.numpy as jnp
+
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    state = {
+        f"w{i}": jnp.arange(1024, dtype=jnp.float32) + i for i in range(4)
+    }
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(**state)})
+
+    SlowReadFSStoragePlugin.read_done_times = []
+    SlowReadFSStoragePlugin.delay_s = 0.2
+    monkeypatch.setattr(
+        sp, "url_to_storage_plugin", lambda url: SlowReadFSStoragePlugin(url)
+    )
+
+    device_put_events = []
+    real_device_put = jax.device_put
+
+    def recording_device_put(*args, **kwargs):
+        device_put_events.append((time.monotonic(), threading.current_thread()))
+        return real_device_put(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "device_put", recording_device_put)
+
+    tgt = StateDict(**{f"w{i}": jnp.zeros(1024, jnp.float32) for i in range(4)})
+    # Force overlap on: the auto default disables it on 1-vCPU hosts.
+    with knobs.override_restore_overlap(True):
+        with knobs.override_max_concurrent_io(1):  # serialize reads
+            Snapshot(path).restore({"s": tgt})
+
+    assert len(device_put_events) == 4
+    assert all(
+        t is threading.main_thread() for _, t in device_put_events
+    ), "jax dispatch must stay on the main thread"
+    first_finalize = min(t for t, _ in device_put_events)
+    last_read = max(SlowReadFSStoragePlugin.read_done_times)
+    # With 4 serialized ~0.2 s reads, an overlapped pump finalizes entry 1
+    # ~0.6 s before the last read; the phase-split design would be after it.
+    assert first_finalize < last_read - 0.1, (first_finalize, last_read)
+    for i in range(4):
+        assert np.array_equal(
+            np.asarray(tgt[f"w{i}"]), np.arange(1024, dtype=np.float32) + i
+        )
+
+
+def test_restore_rss_bounded_by_budget_not_state_size(tmp_path) -> None:
+    """Peak RSS during restore must track (final state + budget + in-flight
+    entry), NOT final state + a full second copy of the state in staging
+    buffers as the phase-split design paid."""
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu.utils.rss_profiler import measure_rss_deltas
+
+    n_entries, entry_mb = 8, 16
+    elems = entry_mb * 1024 * 1024 // 4
+    state = {
+        f"w{i}": np.full(elems, float(i), dtype=np.float32)
+        for i in range(n_entries)
+    }
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(**state)})
+
+    budget = 32 * 1024 * 1024
+    # Live jax targets: every entry is finalized through device_put (on the
+    # CPU backend the "device" arrays are host RSS too — that IS the final
+    # state and is unavoidable; the bound is about transient staging).
+    tgt = StateDict(
+        **{f"w{i}": jnp.zeros(elems, jnp.float32) for i in range(n_entries)}
+    )
+    deltas: list = []
+    with knobs.override_restore_overlap(True):
+        with knobs.override_memory_budget_bytes(budget):
+            with measure_rss_deltas(rss_deltas=deltas):
+                Snapshot(path).restore({"s": tgt})
+    peak = max(deltas)
+    state_bytes = n_entries * entry_mb * 1024 * 1024
+    entry_bytes = entry_mb * 1024 * 1024
+    # Old design: ~2x state (staging copy of everything + final state).
+    # New bound: final state + budget + a couple of in-flight entries +
+    # allocator slack.
+    bound = state_bytes + budget + 2 * entry_bytes + 48 * 1024 * 1024
+    assert peak < bound, f"peak {peak / 1e6:.0f} MB >= bound {bound / 1e6:.0f} MB"
+    for i in range(n_entries):
+        assert float(np.asarray(tgt[f"w{i}"])[0]) == float(i)
+
+
+def test_overlap_disabled_is_phase_split(tmp_path, monkeypatch) -> None:
+    """With the knob off, every finalize runs after the last read — the
+    round-3 behavior the auto gate falls back to on single-core hosts."""
+    import jax
+    import jax.numpy as jnp
+
+    import torchsnapshot_tpu.storage_plugin as sp
+
+    state = {f"w{i}": jnp.arange(64, dtype=jnp.float32) + i for i in range(3)}
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"s": StateDict(**state)})
+
+    SlowReadFSStoragePlugin.read_done_times = []
+    SlowReadFSStoragePlugin.delay_s = 0.1
+    monkeypatch.setattr(
+        sp, "url_to_storage_plugin", lambda url: SlowReadFSStoragePlugin(url)
+    )
+    device_put_times = []
+    real_device_put = jax.device_put
+
+    def recording_device_put(*args, **kwargs):
+        device_put_times.append(time.monotonic())
+        return real_device_put(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "device_put", recording_device_put)
+
+    tgt = StateDict(**{f"w{i}": jnp.zeros(64, jnp.float32) for i in range(3)})
+    with knobs.override_restore_overlap(False):
+        with knobs.override_max_concurrent_io(1):
+            Snapshot(path).restore({"s": tgt})
+    assert min(device_put_times) > max(SlowReadFSStoragePlugin.read_done_times)
+    for i in range(3):
+        assert np.array_equal(
+            np.asarray(tgt[f"w{i}"]), np.arange(64, dtype=np.float32) + i
+        )

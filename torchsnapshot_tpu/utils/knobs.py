@@ -346,6 +346,40 @@ def override_plan_cache(enabled: bool):
     return _override_env(_ENV_PLAN_CACHE, "1" if enabled else "0")
 
 
+_ENV_RESTORE_OVERLAP = "TORCHSNAPSHOT_TPU_RESTORE_OVERLAP"
+
+
+def is_restore_overlap_enabled() -> bool:
+    """Finalize each restored entry (its host→device transfer) as its last
+    read consumes — H2D overlaps the storage reads still in flight, and
+    host buffers free eagerly so restore peak RSS tracks the memory budget
+    rather than the state size.
+
+    Default ``auto``: enabled on multi-core hosts, disabled on single-vCPU
+    hosts — there, jax dispatch concurrent with the busy read pipeline
+    starves the PJRT worker thread (measured 2.5-10x slower restores on the
+    reshard workload) and overlap cannot win anyway (no spare core to
+    overlap onto). ``1``/``0`` force it either way."""
+    val = os.environ.get(_ENV_RESTORE_OVERLAP, "auto").lower()
+    if val in ("auto", ""):
+        return _usable_cpu_count() > 1
+    return val not in ("0", "false", "off")
+
+
+def _usable_cpu_count() -> int:
+    """CPUs this process may actually run on — cgroup/affinity aware, so a
+    quota'd container with many visible-but-unusable CPUs doesn't
+    auto-enable concurrency that can't win."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # pragma: no cover - non-Linux
+        return os.cpu_count() or 1
+
+
+def override_restore_overlap(enabled: bool):
+    return _override_env(_ENV_RESTORE_OVERLAP, "1" if enabled else "0")
+
+
 _ENV_PLAN_CACHE_SIZE = "TORCHSNAPSHOT_TPU_PLAN_CACHE_SIZE"
 
 
