@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Regenerate published headline numbers from the newest BENCH_r*.json.
+
+The driver runs ``bench.py`` on real TPU hardware at the end of every round
+and records the parsed result in ``BENCH_r<N>.json``. Hand-maintained copies
+of those numbers drift (round 3 shipped a README quoting round 2's stall);
+this script makes the published tables a *projection of the artifact*:
+
+    python benchmarks/gen_tables.py            # rewrite the generated blocks
+    python benchmarks/gen_tables.py --check    # exit 1 if out of sync (CI)
+
+Generated regions are delimited by ``<!-- BEGIN/END GENERATED: <tag> -->``
+markers in ``benchmarks/README.md`` and the root ``README.md``; everything
+outside the markers is hand-written commentary and never touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def newest_bench() -> tuple[str, dict]:
+    best_round, best_path = -1, None
+    for path in glob.glob(os.path.join(ROOT, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_round:
+            best_round, best_path = int(m.group(1)), path
+    if best_path is None:
+        raise SystemExit("no BENCH_r*.json artifact found at the repo root")
+    with open(best_path) as f:
+        return os.path.basename(best_path), json.load(f)
+
+
+def render_headline_table(src: str, bench: dict) -> str:
+    parsed = bench["parsed"]
+    d = parsed["detail"]
+    ab = (
+        f"{d['sync_take_gbps']:.3f} vs {d['naive_save_gbps']:.3f} GB/s "
+        f"({d['speedup_vs_naive_sync']:.2f}x, {d['ab_reps']} interleaved reps; "
+        f"sync {min(d['sync_gbps_all']):.4f}-{max(d['sync_gbps_all']):.4f}, "
+        f"naive {min(d['naive_gbps_all']):.4f}-{max(d['naive_gbps_all']):.4f})"
+    )
+    lines = [
+        f"Headline (`bench.py`, regenerated from `{src}` — the driver's run "
+        "on the real chip; do not edit by hand, run "
+        "`python benchmarks/gen_tables.py`):",
+        "",
+        "| Metric | Value |",
+        "|---|---|",
+        f"| Checkpoint | {d['size_gb']:.2f} GB bf16 params in HBM |",
+        f"| async-take train-step stall, steady-state | **{d['async_stall_s']:.3f} s** |",
+        f"| async-take stall, first take (incl. XLA compile) | {d['async_stall_cold_s']:.3f} s |",
+        f"| Background drain (D2H + storage I/O) | {d['background_drain_s']:.2f} s |",
+        f"| Reference-equivalent stall on this link | >= {d['ref_equiv_stall_s']:.1f} s "
+        f"(**~{round(parsed['vs_baseline'])}x**) |",
+        f"| Sync take vs naive blocking save | {ab} |",
+        f"| Restore | {'bit-exact' if d['restore_bit_exact'] else 'MISMATCH'} |",
+    ]
+    return "\n".join(lines)
+
+
+def render_readme_bullet(src: str, bench: dict) -> str:
+    parsed = bench["parsed"]
+    d = parsed["detail"]
+    return (
+        f"- **Measured headline** (driver run on a real TPU v5e chip, "
+        f"tunneled D2H link; `{src}`): async-take train-step stall "
+        f"**{d['async_stall_s']:.3f} s steady-state** "
+        f"({d['async_stall_cold_s']:.3f} s first take incl. XLA compile) for "
+        f"a {d['size_gb']:.2f} GB bf16 state — ~{round(parsed['vs_baseline'])}x "
+        f"better than a capture-to-host design on the same link "
+        f"(>= {d['ref_equiv_stall_s']:.1f} s); restore bit-exact."
+    )
+
+
+def splice(text: str, tag: str, payload: str) -> str:
+    begin = f"<!-- BEGIN GENERATED: {tag} -->"
+    end = f"<!-- END GENERATED: {tag} -->"
+    pattern = re.compile(
+        re.escape(begin) + r".*?" + re.escape(end), flags=re.DOTALL
+    )
+    if not pattern.search(text):
+        raise SystemExit(f"marker pair for {tag!r} not found")
+    return pattern.sub(begin + "\n" + payload + "\n" + end, text)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 if the generated blocks are out of sync with the artifact",
+    )
+    args = parser.parse_args()
+
+    src, bench = newest_bench()
+    targets = [
+        (
+            os.path.join(ROOT, "benchmarks", "README.md"),
+            "bench-headline",
+            render_headline_table(src, bench),
+        ),
+        (
+            os.path.join(ROOT, "README.md"),
+            "bench-headline-bullet",
+            render_readme_bullet(src, bench),
+        ),
+    ]
+    stale = []
+    for path, tag, payload in targets:
+        with open(path) as f:
+            text = f.read()
+        updated = splice(text, tag, payload)
+        if updated != text:
+            if args.check:
+                stale.append(path)
+            else:
+                with open(path, "w") as f:
+                    f.write(updated)
+                print(f"regenerated {tag} in {os.path.relpath(path, ROOT)}")
+        else:
+            print(f"{os.path.relpath(path, ROOT)}: {tag} up to date")
+    if stale:
+        print(
+            "STALE generated tables (run `python benchmarks/gen_tables.py`): "
+            + ", ".join(os.path.relpath(p, ROOT) for p in stale)
+        )
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
