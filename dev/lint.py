@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Self-contained lint gate (analogue of the reference's pre-commit hook,
+``/root/reference/.github/workflows/pre_commit.yaml``) with zero
+third-party dependencies, so the exact same check runs in CI and on any dev
+box:
+
+- every Python file must parse (syntax gate);
+- unused imports (AST-walked; ``# noqa`` on the import line suppresses,
+  ``__init__.py`` re-export lists are exempt);
+- no tabs in indentation, no trailing whitespace, files end with a newline.
+
+    python dev/lint.py            # lint the repo
+    python dev/lint.py FILES...   # lint specific files
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_DIRS = ("torchsnapshot_tpu", "tests", "benchmarks", "examples", "dev", "docs")
+LINT_FILES = ("bench.py", "__graft_entry__.py")
+
+
+def iter_targets(argv: list[str]) -> list[str]:
+    if argv:
+        return argv
+    out = []
+    for d in LINT_DIRS:
+        for dirpath, _, filenames in os.walk(os.path.join(ROOT, d)):
+            out.extend(
+                os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+            )
+    out.extend(os.path.join(ROOT, f) for f in LINT_FILES)
+    return sorted(p for p in out if os.path.exists(p))
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c -> record the root name
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # Names referenced only in string annotations / docstring doctests are
+    # not resolvable statically; __all__ strings count as uses.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    return used
+
+
+def unused_imports(tree: ast.AST, source_lines: list[str]) -> list:
+    used = _used_names(tree)
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        line = source_lines[node.lineno - 1]
+        if "noqa" in line:
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in used:
+                problems.append((node.lineno, f"unused import: {bound}"))
+    return problems
+
+
+def lint_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    problems = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = source.split("\n")
+    if not os.path.basename(path) == "__init__.py":
+        problems.extend(unused_imports(tree, lines))
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            problems.append((i, "trailing whitespace"))
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            problems.append((i, "tab in indentation"))
+    if source and not source.endswith("\n"):
+        problems.append((len(lines), "no newline at end of file"))
+    return problems
+
+
+def main() -> None:
+    failed = 0
+    for path in iter_targets(sys.argv[1:]):
+        for lineno, msg in lint_file(path):
+            print(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
+            failed += 1
+    if failed:
+        print(f"\n{failed} lint problem(s)")
+        sys.exit(1)
+    print("lint clean")
+
+
+if __name__ == "__main__":
+    main()

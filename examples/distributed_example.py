@@ -26,7 +26,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchsnapshot_tpu import RNGState, Snapshot, StateDict
+from torchsnapshot_tpu import RNGState, Snapshot
 from torchsnapshot_tpu.models.transformer import (
     TransformerConfig,
     init_params,

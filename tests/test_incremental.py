@@ -10,7 +10,6 @@ bytes. Deleting the base later must NOT invalidate the incremental.
 import os
 
 import numpy as np
-import pytest
 
 from torchsnapshot_tpu import Snapshot, StateDict
 from torchsnapshot_tpu.utils import knobs

@@ -9,7 +9,7 @@ another — the scale-up/scale-down story for expert parallelism.
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from torchsnapshot_tpu import Snapshot
 from torchsnapshot_tpu.models.moe import (

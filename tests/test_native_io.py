@@ -6,7 +6,6 @@ O_DIRECT (tmpfs), the disable knob, and FS-plugin integration parity with the
 pure-Python path.
 """
 
-import io
 import os
 
 import numpy as np

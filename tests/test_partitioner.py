@@ -5,7 +5,6 @@ from typing import List
 import numpy as np
 
 from torchsnapshot_tpu.io_preparer import prepare_write
-from torchsnapshot_tpu.manifest import ArrayEntry
 from torchsnapshot_tpu.parallel.coordinator import Coordinator
 from torchsnapshot_tpu.parallel.store import LocalStore
 from torchsnapshot_tpu.partitioner import partition_write_reqs

@@ -11,7 +11,6 @@ only), so tests force it explicitly.
 """
 
 import asyncio
-import os
 import threading
 import time
 

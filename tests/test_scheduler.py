@@ -3,16 +3,13 @@
 
 import asyncio
 
-import numpy as np
 import pytest
 
 from torchsnapshot_tpu.io_types import (
     BufferConsumer,
     BufferStager,
     ReadReq,
-    StoragePlugin,
     WriteIO,
-    ReadIO,
     WriteReq,
 )
 from torchsnapshot_tpu.scheduler import (

@@ -7,7 +7,6 @@ so a small operator VM can random-access one entry of a huge checkpoint.
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchsnapshot_tpu import Snapshot, StateDict

@@ -4,7 +4,6 @@ another (reference model: ``tests/test_sharded_tensor_resharding.py:35-60``).
 Runs on the virtual 8-device CPU platform from conftest.
 """
 
-import itertools
 
 import jax
 import jax.numpy as jnp

@@ -1,16 +1,14 @@
 """End-to-end single-process take/restore/read_object
 (reference model: ``tests/test_snapshot.py`` + ``examples/simple_example.py``)."""
 
-import os
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from torchsnapshot_tpu import RNGState, Snapshot, StateDict
-from torchsnapshot_tpu.test_utils import assert_state_dict_eq, check_state_dict_eq
+from torchsnapshot_tpu.test_utils import assert_state_dict_eq
 from torchsnapshot_tpu.utils import knobs
 
 

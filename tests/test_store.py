@@ -1,7 +1,6 @@
 """TCPStore / LocalStore / LinearBarrier unit tests
 (reference model: ``tests/test_dist_store.py``)."""
 
-import pickle
 import threading
 import time
 
@@ -12,7 +11,6 @@ from torchsnapshot_tpu.parallel.store import (
     LinearBarrier,
     LocalStore,
     TCPStore,
-    free_port,
 )
 
 

@@ -8,7 +8,7 @@ import optax
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu import Snapshot
 from torchsnapshot_tpu.tricks.train_state import Box, PyTreeStateful
 
 

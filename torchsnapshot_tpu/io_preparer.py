@@ -23,17 +23,13 @@ chunks for transfer/I-O pipelining.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Set, Tuple
 
 import numpy as np
 
 from .io_types import WriteReq
 from .manifest import (
-    ArrayEntry,
-    ChunkedArrayEntry,
-    Entry,
     Manifest,
-    ObjectEntry,
     PrimitiveEntry,
     PRIMITIVE_TYPES,
 )

@@ -20,7 +20,6 @@ reference's ``torch.save`` fallback, ``tensor.py:66-69``).
 from __future__ import annotations
 
 import asyncio
-import math
 import pickle
 from concurrent.futures import Executor
 from typing import Any, Callable, List, Optional, Sequence, Tuple

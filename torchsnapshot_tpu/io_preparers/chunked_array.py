@@ -16,8 +16,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..io_types import ReadReq, WriteReq
-from ..manifest import ArrayEntry, ChunkedArrayEntry, Shard
-from ..serialization import array_nbytes
+from ..manifest import ChunkedArrayEntry, Shard
 from ..utils import knobs
 from .array import ArrayIOPreparer
 

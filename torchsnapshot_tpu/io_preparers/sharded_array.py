@@ -39,7 +39,7 @@ from ..serialization import (
     string_to_dtype,
 )
 from ..utils import knobs
-from .array import ArrayIOPreparer, FramedSliceConsumer, plan_frame_groups
+from .array import ArrayIOPreparer, FramedSliceConsumer
 
 # A target to restore into: (host buffer, global offsets, sizes)
 TargetShard = Tuple[np.ndarray, Sequence[int], Sequence[int]]

@@ -27,7 +27,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 _DEFAULT_TIMEOUT_S = 300.0
 

@@ -52,7 +52,7 @@ from .io_preparers.sharded_array import (
     alloc_target_shards,
     assemble_jax_array,
 )
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO
 from .manifest import (
     ArrayEntry,
     ChunkedArrayEntry,

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..utils import knobs

@@ -25,7 +25,7 @@ Usage::
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+from typing import Any, Dict, Generic, TypeVar
 
 import jax
 
