@@ -102,6 +102,11 @@ class ArrayBufferStager(BufferStager):
         # rather than spinning as an orphaned task.
         self.frame_sizes: Optional[List[int]] = None
         self.frame_error: Optional[BaseException] = None
+        # Set by the batcher when this request joins a member-framed
+        # compressed slab: stage the RAW bytes (the slab compresses all
+        # members together at the slab level); entry.serializer still
+        # records the codec for the read side.
+        self.stage_raw = False
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         if not self.entry.frame_bytes:
@@ -115,12 +120,21 @@ class ArrayBufferStager(BufferStager):
             raise
 
     async def _stage_inner(self, executor: Optional[Executor] = None) -> BufferType:
+        # stage_raw (member of a compressed slab): the slab stager consumes
+        # this buffer synchronously inside ITS staging call (copied into the
+        # packed slab), so a zero-copy view is mutation-safe without the
+        # async defensive copy below.
+        serializer = Serializer.RAW if self.stage_raw else self.entry.serializer
         arr = self.arr
         if _is_jax_array(arr):
             host = await to_host(arr, executor)()
         else:
             host = np.asarray(arr)
-            if self.is_async_snapshot and self.entry.serializer == Serializer.RAW:
+            if (
+                self.is_async_snapshot
+                and serializer == Serializer.RAW
+                and not self.stage_raw
+            ):
                 # Host arrays stage *before* async_take returns, but the RAW
                 # staged buffer is a zero-copy view that the background
                 # write reads after training resumed — copy so training can
@@ -131,7 +145,7 @@ class ArrayBufferStager(BufferStager):
                 host = host.copy()
             elif not host.flags["C_CONTIGUOUS"]:
                 host = np.ascontiguousarray(host)
-        if self.entry.serializer == Serializer.RAW:
+        if serializer == Serializer.RAW:
             return array_as_bytes_view(host)
         if is_raw_family(self.entry.serializer):
             # Compress on the executor: seconds of zstd on a large shard
@@ -182,44 +196,69 @@ class ArrayBufferStager(BufferStager):
                 pass
 
 
-class FrameTableStager(BufferStager):
-    """Stages a framed payload's ``<location>.ftab`` side object: tiny JSON
-    ``{"frame_bytes": F, "sizes": [...]}``.
+class PollingTableStager(BufferStager):
+    """Base for ``.ftab`` side-object stagers: polls a main stager's
+    published ``frame_sizes`` and encodes a JSON table.
 
     The sizes exist only after the main stager compressed the payload (which
     is why they can't live in the manifest — it is gathered before staging),
     so this stager polls the main stager's published result. Both requests
     run in the same pipeline; the poll holds no executor thread and the main
     request always runs (dedup link-in decisions happen after staging), so
-    this terminates.
+    this terminates. The generous deadline guards that invariant: if a
+    future change ever drops/filters the payload req from this rank's
+    pipeline, fail loudly with the payload location instead of hanging the
+    pipeline forever (ADVICE round 3, item 2).
     """
 
-    def __init__(self, main: ArrayBufferStager) -> None:
-        self.main = main
+    POLL_TIMEOUT_S = 1800.0
+
+    def __init__(self, main: Any, described: str) -> None:
+        self.main = main  # must expose frame_sizes / frame_error
+        self.described = described
+
+    def _table(self) -> dict:
+        raise NotImplementedError
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         import json
+        import time
 
+        deadline = time.monotonic() + self.POLL_TIMEOUT_S
         while self.main.frame_sizes is None:
             if self.main.frame_error is not None:
                 raise RuntimeError(
-                    f"frame table for {self.main.entry.location} unavailable: "
+                    f"frame table for {self.described} unavailable: "
                     "payload staging failed"
                 ) from self.main.frame_error
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"frame table for {self.described} never materialized: "
+                    "the payload write request did not stage within the "
+                    "deadline — was it dropped from this rank's pipeline?"
+                )
             await asyncio.sleep(0.005)
-        return json.dumps(
-            {
-                "frame_bytes": self.main.entry.frame_bytes,
-                "sizes": self.main.frame_sizes,
-            }
-        ).encode()
+        return json.dumps(self._table()).encode()
 
     def get_staging_cost_bytes(self) -> int:
         # ~8 digits per frame size; a 4 GB payload at 8 MiB frames is ~4 KB.
-        return 8192
+        return 16384
 
     def start_d2h_hint(self) -> None:
         pass  # no device data of its own
+
+
+class FrameTableStager(PollingTableStager):
+    """``.ftab`` of a uniformly framed payload: ``{"frame_bytes", "sizes"}``."""
+
+    def __init__(self, main: ArrayBufferStager) -> None:
+        super().__init__(main, described=main.entry.location)
+
+    def _table(self) -> dict:
+        return {
+            "frame_bytes": self.main.entry.frame_bytes,
+            "sizes": self.main.frame_sizes,
+        }
 
 
 def plan_frame_groups(
@@ -262,10 +301,13 @@ class FramedSliceConsumer(BufferConsumer):
     frames may cover a superset (frame alignment), which is sliced off.
     """
 
-    # Read-merging must never coalesce framed groups: their COMPRESSED
-    # ranges are adjacent, so a compressed-span cap would re-create the
-    # whole-object decode the budget split exists to avoid. Checked (via
-    # any wrapper's proxy) by ``batcher.batch_read_requests``.
+    # Read-merging must never coalesce a BIG array's framed groups: their
+    # COMPRESSED ranges are adjacent, so a compressed-span cap would
+    # re-create the whole-object decode the budget split exists to avoid.
+    # Checked (via any wrapper's proxy) by ``batcher.batch_read_requests``.
+    # Member-framed SLAB reads opt out (``merge_exempt=False``): each
+    # member decodes independently, so adjacent members' compressed ranges
+    # merge into one ranged read safely.
     merge_exempt = True
 
     def __init__(
@@ -276,6 +318,7 @@ class FramedSliceConsumer(BufferConsumer):
         raw_end: int,
         deliver: Callable[[memoryview], None],
         decoded_raw_bytes: Optional[int] = None,
+        merge_exempt: bool = True,
     ) -> None:
         self.serializer = serializer
         self.group_raw_begin = group_raw_begin
@@ -285,6 +328,7 @@ class FramedSliceConsumer(BufferConsumer):
         # Frame alignment can force decoding more raw bytes than the
         # requested slice; the budget must see the true peak.
         self.decoded_raw_bytes = decoded_raw_bytes
+        self.merge_exempt = merge_exempt
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -416,6 +460,80 @@ class ChunkedReadConsumer(BufferConsumer):
         return self.byte_range[1] - self.byte_range[0]
 
 
+def _member_deliver(target: np.ndarray, entry: ArrayEntry):
+    """Deliver one slab member's raw bytes into the host target."""
+
+    def deliver(mv: memoryview) -> None:
+        src = array_from_bytes(mv, entry.dtype, entry.shape)
+        np.copyto(target, src, casting="no")
+
+    return deliver
+
+
+def _member_framed_reads(
+    entry: ArrayEntry, target: np.ndarray, frame_table
+) -> List[ReadReq]:
+    """Read one member of a member-framed compressed slab.
+
+    With the slab's ``.ftab`` (``{"raw_sizes": [...], "sizes": [...]}``) the
+    member's raw range resolves to its covering frames and a compressed
+    byte-range read; without it (side object lost), degrade to reading and
+    decoding the WHOLE slab and slicing the member out — slower, never a
+    failed restore."""
+    a, b = entry.raw_range
+    if isinstance(frame_table, dict):
+        raw_sizes = frame_table["raw_sizes"]
+        comp_sizes = frame_table["sizes"]
+        rprefix, cprefix = [0], [0]
+        for r in raw_sizes:
+            rprefix.append(rprefix[-1] + int(r))
+        for c in comp_sizes:
+            cprefix.append(cprefix[-1] + int(c))
+        # Covering frame run [i, j): frames are member-aligned, so a lands
+        # on a frame boundary for well-formed manifests; tolerate interior
+        # starts anyway.
+        i = max(0, next((k for k in range(len(raw_sizes)) if rprefix[k + 1] > a), 0))
+        j = next(
+            (k + 1 for k in range(i, len(raw_sizes)) if rprefix[k + 1] >= b),
+            len(raw_sizes),
+        )
+        return [
+            ReadReq(
+                path=entry.location,
+                buffer_consumer=FramedSliceConsumer(
+                    entry.serializer,
+                    group_raw_begin=rprefix[i],
+                    raw_begin=a,
+                    raw_end=b,
+                    deliver=_member_deliver(target, entry),
+                    decoded_raw_bytes=rprefix[j] - rprefix[i],
+                    merge_exempt=False,
+                ),
+                byte_range=(cprefix[i], cprefix[j]),
+            )
+        ]
+    return [
+        ReadReq(
+            path=entry.location,
+            buffer_consumer=FramedSliceConsumer(
+                entry.serializer,
+                group_raw_begin=0,
+                raw_begin=a,
+                raw_end=b,
+                deliver=_member_deliver(target, entry),
+                # The whole slab decodes per member here; without the table
+                # its raw extent is unknown, so bill the slab threshold
+                # (slabs close at it) — over-billing serializes these
+                # degraded reads through the budget instead of letting N
+                # concurrent whole-slab decodes blow past it.
+                decoded_raw_bytes=max(
+                    knobs.get_slab_size_threshold_bytes(), b - a
+                ),
+            ),
+        )
+    ]
+
+
 class ArrayIOPreparer:
     @staticmethod
     def prepare_write(
@@ -469,9 +587,14 @@ class ArrayIOPreparer:
         ``frame_table`` (the compressed frame sizes from the entry's
         ``.ftab`` side object) enables budgeted sub-reads of framed
         compressed entries: each read fetches one group of frames and
-        decompresses only those.
+        decompresses only those. For member-framed slab members
+        (``entry.raw_range``) the table is a dict carrying per-frame raw AND
+        compressed sizes; the member's raw range maps to exactly its own
+        covering frames.
         """
         ensure_codec_available(entry.serializer)
+        if getattr(entry, "raw_range", None) is not None:
+            return _member_framed_reads(entry, target, frame_table)
         if (
             entry.frame_bytes
             and frame_table is not None

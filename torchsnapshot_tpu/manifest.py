@@ -96,6 +96,15 @@ class ArrayEntry(Entry):
     # compressed arrays byte-range addressable (budgeted sub-reads decompress
     # only the covering frames). None = single-blob payload.
     frame_bytes: Optional[int] = None
+    # Member-framed compressed SLAB members only: this entry's raw byte range
+    # within the slab's packed (uncompressed) layout. The slab object is a
+    # concatenation of compression frames whose boundaries coincide with
+    # member boundaries; the `<location>.ftab` side object records both the
+    # per-frame raw and compressed sizes, so a member read fetches + decodes
+    # exactly its own frames. Mutually exclusive with byte_range (which is
+    # FILE space) — compressed member sizes aren't known at planning time,
+    # so the manifest can only speak in raw coordinates.
+    raw_range: Optional[List[int]] = None
 
     def __init__(
         self,
@@ -106,6 +115,7 @@ class ArrayEntry(Entry):
         replicated: bool = False,
         byte_range: Optional[List[int]] = None,
         frame_bytes: Optional[int] = None,
+        raw_range: Optional[List[int]] = None,
     ):
         super().__init__(type="array")
         self.location = location
@@ -115,6 +125,7 @@ class ArrayEntry(Entry):
         self.replicated = replicated
         self.byte_range = list(byte_range) if byte_range is not None else None
         self.frame_bytes = int(frame_bytes) if frame_bytes else None
+        self.raw_range = list(raw_range) if raw_range is not None else None
 
 
 @dataclass
@@ -251,6 +262,8 @@ def entry_to_dict(entry: Entry) -> Dict[str, Any]:
             d["byte_range"] = entry.byte_range
         if entry.frame_bytes is not None:
             d["frame_bytes"] = entry.frame_bytes
+        if entry.raw_range is not None:
+            d["raw_range"] = entry.raw_range
     elif isinstance(entry, ShardedArrayEntry):
         d.update(
             dtype=entry.dtype,
@@ -307,6 +320,7 @@ def entry_from_dict(d: Dict[str, Any]) -> Entry:
             d.get("replicated", False),
             d.get("byte_range"),
             d.get("frame_bytes"),
+            d.get("raw_range"),
         )
     if t == "sharded_array":
         return ShardedArrayEntry(

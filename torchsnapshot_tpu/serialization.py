@@ -140,6 +140,27 @@ def compress_framed(view, serializer: str, level: int, frame_bytes: int):
     return b"".join(parts), sizes
 
 
+def compress_member_framed(view, member_sizes, serializer: str, level: int):
+    """Compress ``view`` with one independent frame per MEMBER (member i
+    covers ``member_sizes[i]`` raw bytes). The slab-batching analogue of
+    :func:`compress_framed`: frame boundaries coincide with member
+    boundaries, so reading one member fetches + decodes exactly its own
+    frames — no shared-frame decode amplification across a slab's members.
+    Returns ``(payload_bytes, frame_sizes)``; a whole-payload read decodes
+    with :func:`decode_framed_payload` like any framed stream."""
+    mv = memoryview(view)
+    parts = []
+    sizes = []
+    begin = 0
+    for n in member_sizes:
+        frame = compress_payload(mv[begin : begin + n], serializer, level)
+        parts.append(frame)
+        sizes.append(len(frame))
+        begin += n
+    assert begin == mv.nbytes, (begin, mv.nbytes)
+    return b"".join(parts), sizes
+
+
 def decode_framed_payload(buf, serializer: str):
     """Decode a concatenation of compression frames back to raw bytes.
 

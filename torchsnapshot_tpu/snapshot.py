@@ -1194,10 +1194,12 @@ def _is_jax_array(obj: Any) -> bool:
 
 
 def _wanted_framed_locations(
-    entry: Entry, live: Any, buffer_size_limit_bytes: int
+    entry: Entry, live: Any, buffer_size_limit_bytes: Optional[int]
 ) -> List[str]:
-    """Framed payload locations under ``entry`` whose ``.ftab`` a budgeted
-    restore of this process will actually need.
+    """Framed payload locations under ``entry`` whose ``.ftab`` this
+    process's restore will actually need: member-framed compressed slab
+    members (``raw_range`` — always, the table is how a member's bytes are
+    even located) and big framed payloads a budget will sub-read.
 
     Sharded entries are filtered by overlap with the live target's
     addressable shards — each rank reads only ~1/world of a sharded array's
@@ -1209,15 +1211,21 @@ def _wanted_framed_locations(
 
     def big_and_framed(sub) -> bool:
         return bool(
-            getattr(sub, "frame_bytes", None)
+            buffer_size_limit_bytes is not None
+            and getattr(sub, "frame_bytes", None)
             and array_nbytes(sub.shape, sub.dtype) > buffer_size_limit_bytes
         )
 
+    def member_framed(sub) -> bool:
+        return getattr(sub, "raw_range", None) is not None
+
     out: List[str] = []
-    if isinstance(entry, ArrayEntry) and big_and_framed(entry):
+    if isinstance(entry, ArrayEntry) and (
+        big_and_framed(entry) or member_framed(entry)
+    ):
         out.append(entry.location)
     for chunk in getattr(entry, "chunks", None) or []:
-        if big_and_framed(chunk.tensor):
+        if big_and_framed(chunk.tensor) or member_framed(chunk.tensor):
             out.append(chunk.tensor.location)
     shards = getattr(entry, "shards", None) or []
     if shards:
@@ -1251,24 +1259,25 @@ def _fetch_frame_tables(
     storage: StoragePlugin,
     event_loop: asyncio.AbstractEventLoop,
     buffer_size_limit_bytes: Optional[int],
-) -> Dict[str, List[int]]:
-    """Read the ``.ftab`` side objects of framed compressed entries that a
-    budget will sub-read. Whole-object reads need no table (frames decode by
-    concatenation), so with no budget this is free. A missing/corrupt table
-    degrades to whole-object reads with a warning — never a failed restore."""
+) -> Dict[str, Any]:
+    """Read the ``.ftab`` side objects a restore needs: member-framed
+    compressed slabs (always — the table maps each member's ``raw_range``
+    to its compressed frames; value = ``{"sizes", "raw_sizes"}`` dict) and
+    big framed payloads a budget will sub-read (value = frame-size list;
+    whole-object reads need no table since frames decode by concatenation).
+    A missing/corrupt table degrades to whole-object reads with a warning —
+    never a failed restore."""
     import json as _json
 
     from .io_preparers.array import FRAME_TABLE_SUFFIX
 
-    if buffer_size_limit_bytes is None:
-        return {}
     locations: Dict[str, None] = {}  # insertion-ordered set
     for entry, live in entry_live_pairs:
         for loc in _wanted_framed_locations(entry, live, buffer_size_limit_bytes):
             locations[loc] = None
     if not locations:
         return {}
-    tables: Dict[str, List[int]] = {}
+    tables: Dict[str, Any] = {}
 
     async def fetch_all() -> None:
         sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
@@ -1279,7 +1288,13 @@ def _fetch_frame_tables(
                 try:
                     await storage.read(read_io)
                     parsed = _json.loads(read_io.buf.getvalue().decode())
-                    tables[loc] = [int(s) for s in parsed["sizes"]]
+                    if parsed.get("member_framed"):
+                        tables[loc] = {
+                            "sizes": [int(s) for s in parsed["sizes"]],
+                            "raw_sizes": [int(s) for s in parsed["raw_sizes"]],
+                        }
+                    else:
+                        tables[loc] = [int(s) for s in parsed["sizes"]]
                 except Exception:  # noqa: BLE001 - degrade, don't fail
                     logger.warning(
                         "frame table %s%s unreadable; falling back to a "
