@@ -443,3 +443,42 @@ def test_restore_constant_round_trips(tmp_path) -> None:
     run_with_processes(
         _worker_restore_constant_round_trips, nproc=2, args=(str(tmp_path),)
     )
+
+
+def _worker_keyset_divergence_warns(rank, world_size, shared):
+    """Asymmetric app_state keysets are legal (per-rank statefuls) but a
+    footgun when a skipped stateful's state_dict() issues collectives; the
+    preflight gather carries a keyset checksum so rank 0 SURFACES the
+    asymmetry instead of leaving a later hang undiagnosed (ADVICE round 3,
+    item 4)."""
+    import logging
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logging.getLogger("torchsnapshot_tpu.take_plan").addHandler(handler)
+    try:
+        app = {"common": StateDict(w=np.arange(4, dtype=np.float32))}
+        if rank == 1:
+            app["only_on_rank1"] = StateDict(x=1)
+        Snapshot.take(os.path.join(shared, "c0"), app)
+    finally:
+        logging.getLogger("torchsnapshot_tpu.take_plan").removeHandler(handler)
+    if rank == 0:
+        assert any("Rank-divergent app_state keysets" in m for m in records), records
+    # The take itself still commits and restores fine.
+    tgt = {"common": StateDict(w=np.zeros(4, dtype=np.float32))}
+    Snapshot(os.path.join(shared, "c0")).restore(tgt)
+    assert np.array_equal(tgt["common"]["w"], np.arange(4, dtype=np.float32))
+
+
+def test_keyset_divergence_warns(tmp_path) -> None:
+    run_with_processes(
+        _worker_keyset_divergence_warns, nproc=2, args=(str(tmp_path),)
+    )

@@ -228,6 +228,14 @@ def _install_fake_multipart_s3(monkeypatch, objects: dict, stats: dict, faults: 
             return {"ETag": f"etag-{PartNumber}"}
 
         async def complete_multipart_upload(self, Bucket, Key, UploadId, MultipartUpload):
+            if faults.pop("complete_vanishes", None):
+                # The upload id is gone WITHOUT a commit (e.g. aborted by a
+                # bucket lifecycle rule mid-upload): NoSuchUpload and no
+                # object to probe.
+                self._mpu.pop(UploadId, None)
+                e = Exception("NoSuchUpload")
+                e.response = {"Error": {"Code": "NoSuchUpload"}}
+                raise e
             if UploadId not in self._mpu:
                 # S3 semantics: a consumed upload id (already completed or
                 # aborted) yields NoSuchUpload.
@@ -374,6 +382,27 @@ def test_multipart_complete_committed_server_side_is_success(fake_multipart_s3) 
     assert objects[("bucket", "committed")] == payload
     assert stats.get("heads", 0) >= 1  # the probe ran
     assert stats.get("aborted", 0) == 0  # nothing to abort — it committed
+
+
+def test_probe_failure_surfaces_original_complete_error(fake_multipart_s3) -> None:
+    """When the NoSuchUpload probe itself fails (no committed object — the
+    upload truly vanished), the surfaced error must be the ORIGINAL
+    complete_multipart_upload failure, with the probe error chained beneath
+    it — not the probe's 404 masking the root cause (ADVICE round 3,
+    item 1)."""
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, faults = fake_multipart_s3
+    faults["complete_vanishes"] = True
+    plugin = S3StoragePlugin(root="bucket")
+    with knobs.override_s3_chunk_bytes(1024):
+        with pytest.raises(Exception, match="NoSuchUpload") as excinfo:
+            _run(plugin.write(WriteIO(path="gone", buf=bytes(4096))))
+    _run(plugin.close())
+    # The probe's not-found is the cause, not the headline.
+    assert "NotFound" in repr(excinfo.value.__cause__)
+    assert ("bucket", "gone") not in objects
 
 
 def test_small_objects_keep_single_put(fake_multipart_s3) -> None:

@@ -435,6 +435,13 @@ def batch_write_requests(
             nonlocal slab, slab_entries, offset
             if not slab:
                 return
+            if len(slab) == 1:
+                # A 1-member slab is strictly worse than the plain object
+                # (extra indirection, and a .ftab side object when
+                # compressed): pass the member through untouched.
+                passthrough.append(slab[0][0])
+                slab, slab_entries, offset = [], [], 0
+                return
             slab_path = f"batched/{uuid.uuid4().hex}"
             for (req, begin, end), entry in zip(slab, slab_entries):
                 entry.location = slab_path

@@ -128,16 +128,12 @@ def compress_framed(view, serializer: str, level: int, frame_bytes: int):
     ``[prefix[i], prefix[j])`` of the payload. Deterministic at a fixed
     codec version + level (same property incremental dedup relies on for
     single-blob payloads)."""
-    mv = memoryview(view)
-    parts = []
-    sizes = []
-    for begin in range(0, mv.nbytes, frame_bytes):
-        frame = compress_payload(
-            mv[begin : begin + frame_bytes], serializer, level
-        )
-        parts.append(frame)
-        sizes.append(len(frame))
-    return b"".join(parts), sizes
+    n = memoryview(view).nbytes
+    full, tail = divmod(n, frame_bytes)
+    member_sizes = [frame_bytes] * full + ([tail] if tail else [])
+    if not member_sizes:
+        return b"", []
+    return compress_member_framed(view, member_sizes, serializer, level)
 
 
 def compress_member_framed(view, member_sizes, serializer: str, level: int):

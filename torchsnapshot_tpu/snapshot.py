@@ -284,12 +284,18 @@ class Snapshot:
         # SPMD take counter: every rank increments once per take, so the
         # value doubles as the plan token certifying "stored by take #N".
         coord._take_seq = getattr(coord, "_take_seq", 0) + 1  # type: ignore[attr-defined]
+        import hashlib as _hashlib
+
+        keys_sig = _hashlib.sha1(
+            "\x00".join(sorted(app_state.keys())).encode()
+        ).hexdigest()[:12]
         pf = preflight(
             coord,
             path,
             base,
             replicated,
             plan_token=cached.token if cached is not None else None,
+            keys_sig=keys_sig,
         )
         phases["preflight"] = time.monotonic() - t0
         return TakePlan(

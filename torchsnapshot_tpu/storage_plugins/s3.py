@@ -146,9 +146,17 @@ class S3StoragePlugin(StoragePlugin):
                 # round 2, item 1).
                 if _error_code(complete_exc) != "NoSuchUpload":
                     raise
-                head = await self._retrying(
-                    lambda: client.head_object(Bucket=self.bucket, Key=key)
-                )
+                try:
+                    head = await self._retrying(
+                        lambda: client.head_object(Bucket=self.bucket, Key=key)
+                    )
+                except Exception as probe_exc:
+                    # The probe failing (object truly absent, or transient
+                    # 403/503 past the retry window) must not MASK the
+                    # complete failure it was diagnosing — re-raise the
+                    # original, chained so both are visible (ADVICE round
+                    # 3, item 1).
+                    raise complete_exc from probe_exc
                 if int(head.get("ContentLength", -1)) != mv.nbytes:
                     raise
                 # Size alone can't distinguish THIS upload's commit from a

@@ -64,6 +64,9 @@ from .utils import knobs
 
 logger = logging.getLogger(__name__)
 
+# Keyset-divergence patterns already surfaced by this process (rank 0).
+_WARNED_KEYSET_SIGS: "set" = set()
+
 # Bump when the fingerprint payload or cached-plan layout changes: stale
 # in-process caches from an older scheme must never satisfy a new build.
 _FINGERPRINT_VERSION = 1
@@ -229,6 +232,7 @@ def preflight(
     base: Optional[str],
     replicated_globs: List[str],
     plan_token: Optional[int],
+    keys_sig: Optional[str] = None,
 ) -> PreflightResult:
     """One gather + one broadcast replacing the per-take path/glob/base/key
     all_gathers and deciding hit/miss globally (see module docstring).
@@ -239,6 +243,13 @@ def preflight(
     addressable shards, so fingerprints legitimately differ across ranks —
     and never crosses the wire; hit requires every rank to hold a plan and
     all tokens to match (i.e. all plans were computed by the same take).
+
+    ``keys_sig`` (a checksum of this rank's top-level app-state keys) rides
+    the same gather so rank 0 can surface asymmetric keysets: per-rank-only
+    statefuls are legal, but one whose ``state_dict()`` issues coordinator
+    collectives desyncs the collective generation counters on the ranks
+    that skip it — a later hang with no diagnostic (ADVICE round 3,
+    item 4). Diagnosis only; never changes the decision.
     """
     globs_local = sorted(set(replicated_globs))
     if coord.get_world_size() == 1:
@@ -246,7 +257,7 @@ def preflight(
             hit=False, path=path, base=base, replicated_globs=globs_local
         )
     gathered = coord.gather_object(
-        (path, base, globs_local, plan_token), dst=0
+        (path, base, globs_local, plan_token, keys_sig), dst=0
     )
     decision: Optional[Tuple[bool, str, Optional[str], List[str]]] = None
     if gathered is not None:  # rank 0
@@ -254,6 +265,20 @@ def preflight(
         bases = [g[1] for g in gathered]
         globs = [g[2] for g in gathered]
         tokens = [g[3] for g in gathered]
+        keys_sigs = [g[4] for g in gathered]
+        sig_set = frozenset(keys_sigs)
+        if len(sig_set) > 1 and sig_set not in _WARNED_KEYSET_SIGS:
+            # Once per distinct divergence pattern: a legal per-rank
+            # stateful would otherwise log every take for the whole run.
+            _WARNED_KEYSET_SIGS.add(sig_set)
+            logger.warning(
+                "Rank-divergent app_state keysets (key checksums %s). "
+                "Per-rank-only statefuls are fine, but any stateful whose "
+                "state_dict()/load_state_dict() issues collectives must be "
+                "present on EVERY rank, or the ranks that skip it will "
+                "desynchronize and a later collective will hang.",
+                keys_sigs,
+            )
         if any(p != paths[0] for p in paths):
             logger.warning(
                 "Rank-divergent snapshot paths %s; using rank 0's: %s",
