@@ -91,7 +91,7 @@ def test_rank_asymmetric_globs_dropped(caplog) -> None:
         2,
         [
             None,  # replaced by rank 0's own payload
-            ("/tmp/snap", None, ["b/**", "c/**"], None),
+            ("/tmp/snap", None, ["b/**", "c/**"], None, None),
         ],
     )
     with caplog.at_level(logging.WARNING):
@@ -108,7 +108,7 @@ def test_rank_divergent_path_uses_rank0(caplog) -> None:
         2,
         [
             None,
-            ("/snap/rank1", None, [], 5),
+            ("/snap/rank1", None, [], 5, None),
         ],
     )
     with caplog.at_level(logging.WARNING):
@@ -122,16 +122,16 @@ def test_rank_divergent_path_uses_rank0(caplog) -> None:
 def test_token_divergence_forces_miss() -> None:
     # Ranks hold plans from DIFFERENT takes: their partition assignments
     # may not compose, so the preflight must force a miss.
-    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 4)])
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 4, None)])
     pf = preflight(coord, "/snap", None, [], 5)
     assert not pf.hit
 
 
 def test_missing_cached_plan_forces_miss() -> None:
-    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], None)])
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], None, None)])
     pf = preflight(coord, "/snap", None, [], 5)
     assert not pf.hit
-    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 5)])
+    coord = _FakeCoordinator(0, 2, [None, ("/snap", None, [], 5, None)])
     pf = preflight(coord, "/snap", None, [], None)
     assert not pf.hit
 
