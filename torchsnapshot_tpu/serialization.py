@@ -22,8 +22,9 @@ Serializer families:
   ``TORCHSNAPSHOT_TPU_COMPRESSION_FRAME_BYTES`` are FRAMED — independent
   frames per fixed raw window, compressed frame sizes in a ``.ftab`` side
   object — so budgeted sub-reads stay byte-range addressable (they fetch and
-  decompress only covering frames); smaller payloads are single blobs that
-  slab batching compresses eagerly at plan time so they coalesce too. The
+  decompress only covering frames); smaller payloads are single blobs unless
+  slab batching coalesces them into member-framed compressed slabs (one
+  frame per member, compressed at staging time). The
   serializer is recorded per entry, so restore auto-detects regardless of
   current knobs, and a compressed and an uncompressed snapshot can coexist.
 - ``pickle``: ``pickle`` of arbitrary Python objects. Fallback for

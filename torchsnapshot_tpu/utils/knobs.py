@@ -172,8 +172,9 @@ def get_compression() -> str:
     throughput and shrinking checkpoints by the same factor. Composes with
     byte ranges: large payloads are framed (see
     ``get_compression_frame_bytes``) so budgeted sub-reads stay ranged, and
-    small payloads compress eagerly at batch-planning time so slabs
-    coalesce them.
+    small payloads join member-framed compressed slabs (batching AND
+    compression, compressed at staging time — async device entries on the
+    background drain).
 
     Stall note: device arrays compress in the background drain, but
     *mutable host* arrays stage (and therefore compress) before
