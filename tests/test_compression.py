@@ -186,6 +186,50 @@ def test_async_device_compressed_entries_batch_into_slabs(tmp_path) -> None:
     assert np.array_equal(np.asarray(got), np.arange(256, dtype=np.float32))
 
 
+def _worker_replicated_compressed_slab(rank, world_size, shared):
+    """Replicated small compressed arrays across ranks: the partitioner
+    assigns the writes to one rank, whose slab batching relocates the
+    entries to a batched/ object via raw_range — consolidation must
+    propagate that relocation (location + raw_range) to every rank's
+    manifest copy, or non-writer ranks restore from a path that was never
+    written."""
+    import os
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs
+
+    src = {
+        f"t{i}": (np.arange(512, dtype=np.float32) + i) for i in range(6)
+    }
+    path = os.path.join(shared, "ckpt")
+    with knobs.override_batching_enabled(True), knobs.override_compression("zstd"):
+        Snapshot.take(
+            path, {"m": StateDict(**src)}, replicated=["m/*"]
+        )
+    manifest = Snapshot(path).get_manifest()
+    # Every rank's copy of each replicated entry points at the same slab.
+    for i in range(6):
+        per_rank = [manifest[f"{r}/m/t{i}"] for r in range(world_size)]
+        locs = {e.location for e in per_rank}
+        assert len(locs) == 1, locs
+        assert all(e.raw_range is not None for e in per_rank), per_rank
+        assert next(iter(locs)).startswith("batched/"), locs
+    assert Snapshot(path).verify() == {}
+    tgt = {"m": StateDict(**{f"t{i}": np.zeros(512, np.float32) for i in range(6)})}
+    Snapshot(path).restore(tgt)
+    for i in range(6):
+        assert np.array_equal(tgt["m"][f"t{i}"], src[f"t{i}"])
+
+
+@pytest.mark.multiprocess
+def test_replicated_compressed_slab_consolidates_across_ranks(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    run_with_processes(
+        _worker_replicated_compressed_slab, nproc=2, args=(str(tmp_path),)
+    )
+
+
 def test_compressed_slab_ftab_lost_degrades_to_whole_slab_read(tmp_path, caplog) -> None:
     """A lost/corrupt slab frame table degrades to reading + decoding the
     whole slab and slicing members out — never a failed restore."""

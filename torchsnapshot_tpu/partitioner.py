@@ -167,7 +167,11 @@ def consolidate_replicated_entries(global_manifest: Manifest) -> None:
             by_path.setdefault(path, []).append(entry)
 
     def relocated(e: ArrayEntry) -> bool:
-        return e.byte_range is not None
+        # byte_range: raw slab membership; raw_range: member-framed
+        # COMPRESSED slab membership. Either means the writer rank moved
+        # the bytes to a batched/ object the other ranks' copies must
+        # point at.
+        return e.byte_range is not None or e.raw_range is not None
 
     for entries in by_path.values():
         if isinstance(entries[0], ArrayEntry):
@@ -175,6 +179,7 @@ def consolidate_replicated_entries(global_manifest: Manifest) -> None:
             for e in entries:
                 e.location = chosen.location
                 e.byte_range = chosen.byte_range
+                e.raw_range = chosen.raw_range
         elif isinstance(entries[0], ChunkedArrayEntry):
             # Chunks of one entry may have been written (and relocated) by
             # different ranks; merge per chunk, keyed by offsets.
