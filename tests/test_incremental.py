@@ -159,6 +159,37 @@ def test_incremental_dedups_batched_slabs_by_content(tmp_path) -> None:
     assert Snapshot(inc).verify() == {}
 
 
+def test_incremental_dedups_compressed_slabs(tmp_path) -> None:
+    """Member-framed COMPRESSED slabs dedup too: member packing order and
+    zstd at a fixed level are deterministic, so an unchanged state's slab
+    bytes (and its .ftab) are byte-identical across takes and hard-link via
+    the content-keyed index despite fresh batched/<uuid> paths."""
+    base = str(tmp_path / "a")
+    inc = str(tmp_path / "b")
+    arrs = {f"p{i}": np.arange(512, dtype=np.float32) + i for i in range(10)}
+    with knobs.override_batching_enabled(True), knobs.override_compression("zstd"):
+        Snapshot.take(base, {"m": StateDict(**arrs)})
+        Snapshot.take(inc, {"m": StateDict(**arrs)}, base=base)
+    import glob as _glob
+
+    def slab_and_tab(root):
+        paths = _glob.glob(os.path.join(root, "batched", "*"))
+        (slab,) = [p for p in paths if not p.endswith(".ftab")]
+        (tab,) = [p for p in paths if p.endswith(".ftab")]
+        return slab, tab
+
+    base_slab, base_tab = slab_and_tab(base)
+    inc_slab, inc_tab = slab_and_tab(inc)
+    assert os.stat(base_slab).st_ino == os.stat(inc_slab).st_ino  # linked
+    # The .ftab side object dedups as well.
+    assert os.stat(base_tab).st_ino == os.stat(inc_tab).st_ino
+    out = StateDict()
+    Snapshot(inc).restore({"m": out})
+    for i in range(10):
+        assert np.array_equal(out[f"p{i}"], arrs[f"p{i}"])
+    assert Snapshot(inc).verify() == {}
+
+
 def test_chained_incrementals(tmp_path) -> None:
     """s0 -> s1 -> s2: each step links unchanged objects against its direct
     predecessor; all restore bit-exactly and verify clean."""
