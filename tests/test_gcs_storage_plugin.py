@@ -122,6 +122,15 @@ def fake_gcs(monkeypatch):
     return blobs, fail_reads
 
 
+def _bucket_copies():
+    """The installed fake's (src, dst) server-side-copy ledger, cleared."""
+    import sys as _sys
+
+    cls = type(_sys.modules["google.cloud.storage"].Client().bucket("bucket"))
+    cls.copies.clear()
+    return cls.copies
+
+
 def test_write_read_roundtrip(fake_gcs) -> None:
     blobs, _ = fake_gcs
     from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
@@ -298,17 +307,12 @@ def test_live_snapshot_roundtrip(tmp_path) -> None:
 def test_incremental_take_uses_server_side_copies(fake_gcs, monkeypatch) -> None:
     """take(base=gs://...) dedups via GCS server-side copies: unchanged
     objects are copied bucket-side, never re-uploaded from this host."""
-    import sys as _sys
-
     import numpy as np
 
     from torchsnapshot_tpu import Snapshot, StateDict
 
     blobs, _ = fake_gcs
-    fake_bucket_cls = type(
-        _sys.modules["google.cloud.storage"].Client().bucket("bucket")
-    )
-    fake_bucket_cls.copies.clear()
+    copies = _bucket_copies()
     frozen = {f"b{i}": np.arange(500, dtype=np.float32) + i for i in range(3)}
 
     def app(step):
@@ -316,13 +320,44 @@ def test_incremental_take_uses_server_side_copies(fake_gcs, monkeypatch) -> None
 
     Snapshot.take("gs://bucket/s0", app(0))
     Snapshot.take("gs://bucket/s1", app(1), base="gs://bucket/s0")
-    copied_dsts = {dst for _, dst in fake_bucket_cls.copies}
+    copied_dsts = {dst for _, dst in copies}
     assert {f"s1/0/m/b{i}" for i in range(3)} <= copied_dsts
     assert "s1/0/m/head" not in copied_dsts  # changed: re-uploaded
     out = StateDict()
     Snapshot("gs://bucket/s1").restore({"m": out})
     assert np.array_equal(out["head"], np.full((10,), 1, np.float32))
     assert np.array_equal(out["b2"], frozen["b2"])
+
+
+def test_incremental_server_side_copies_compressed_slabs(fake_gcs) -> None:
+    """Member-framed compressed slabs dedup on GCS too: slab paths are
+    fresh batched/<uuid> every take, so the content-keyed index must drive
+    a server-side copy to the NEW path (and the .ftab with it) instead of
+    re-uploading."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs
+
+    blobs, _ = fake_gcs
+    copies = _bucket_copies()
+    frozen = {f"b{i}": np.arange(512, dtype=np.float32) + i for i in range(6)}
+
+    with knobs.override_batching_enabled(True), knobs.override_compression("zstd"):
+        Snapshot.take("gs://bucket/s0", {"m": StateDict(**frozen)})
+        Snapshot.take(
+            "gs://bucket/s1", {"m": StateDict(**frozen)}, base="gs://bucket/s0"
+        )
+    copied_dsts = {dst for _, dst in copies}
+    slab_copies = {d for d in copied_dsts if d.startswith("s1/batched/")}
+    # The slab payload and its .ftab both arrive by server-side copy.
+    assert any(not d.endswith(".ftab") for d in slab_copies), copied_dsts
+    assert any(d.endswith(".ftab") for d in slab_copies), copied_dsts
+    out = StateDict()
+    Snapshot("gs://bucket/s1").restore({"m": out})
+    for i in range(6):
+        assert np.array_equal(out[f"b{i}"], frozen[f"b{i}"])
+    assert Snapshot("gs://bucket/s1").verify() == {}
 
 
 def test_absent_object_normalized_to_file_not_found(fake_gcs) -> None:
