@@ -7,7 +7,11 @@ box:
 - every Python file must parse (syntax gate);
 - unused imports (AST-walked; ``# noqa`` on the import line suppresses,
   ``__init__.py`` re-export lists are exempt);
-- no tabs in indentation, no trailing whitespace, files end with a newline.
+- no tabs in indentation, no trailing whitespace, files end with a newline;
+- generated benchmark tables in README.md / benchmarks/README.md match the
+  newest ``BENCH_r*.json`` artifact (delegates to
+  ``benchmarks/gen_tables.py --check``), so a driver-recorded regression can
+  never stay invisible in the human-facing docs.
 
     python dev/lint.py            # lint the repo
     python dev/lint.py FILES...   # lint specific files
@@ -100,12 +104,31 @@ def lint_file(path: str) -> list:
     return problems
 
 
+def check_generated_tables() -> int:
+    """Fail when the published tables drifted from the newest BENCH artifact."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "gen_tables.py"), "--check"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        return 1
+    return 0
+
+
 def main() -> None:
     failed = 0
+    explicit_files = bool(sys.argv[1:])
     for path in iter_targets(sys.argv[1:]):
         for lineno, msg in lint_file(path):
             print(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
             failed += 1
+    if not explicit_files:
+        failed += check_generated_tables()
     if failed:
         print(f"\n{failed} lint problem(s)")
         sys.exit(1)
