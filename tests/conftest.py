@@ -20,6 +20,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Dedup digests default to `auto` (off on single-vCPU hosts, where the
+# sha256 interferes with the CPU-fed device transfer). The incremental-dedup
+# feature tests must behave identically on any CI box — including one whose
+# ambient environment exports this knob — so pin them on unconditionally;
+# the auto gate itself is covered explicitly in test_knobs.py.
+os.environ["TORCHSNAPSHOT_TPU_DEDUP_DIGESTS"] = "1"
+
 # --- Global hang guard -------------------------------------------------------
 # The reference pins a 300 s per-test timeout for every run (pytest.ini:1-7).
 # pyproject.toml's `timeout = 300` covers CI (pytest-timeout installed there);

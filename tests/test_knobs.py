@@ -173,3 +173,25 @@ def test_budget_override_still_derives_local_world_size() -> None:
         assert knobs.get_max_concurrent_io(shared_local_device=True) == 4
     finally:
         knobs.set_local_world_size(1)
+
+
+def test_dedup_digests_auto_gate(monkeypatch) -> None:
+    """Default `auto`: sha256 dedup identities are recorded when a spare
+    core can hide the hash, or when the take itself passes ``base=``;
+    forced values win either way."""
+    from torchsnapshot_tpu.utils import knobs
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEDUP_DIGESTS", "auto")
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
+    assert knobs.is_dedup_digests_enabled() is False
+    # base= forces the identity on: dedup is the point of that take.
+    assert knobs.is_dedup_digests_enabled(has_base=True) is True
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 8)
+    assert knobs.is_dedup_digests_enabled() is True
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEDUP_DIGESTS", "0")
+    assert knobs.is_dedup_digests_enabled() is False
+    assert knobs.is_dedup_digests_enabled(has_base=True) is False
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEDUP_DIGESTS", "1")
+    assert knobs.is_dedup_digests_enabled() is True

@@ -57,15 +57,17 @@ logger = logging.getLogger(__name__)
 CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
 
-def _digest_buffer(mv: memoryview) -> list:
+def _digest_buffer(mv: memoryview, want_sha: bool) -> list:
     """[crc32, size, sha256-hex | None] of one staged buffer. crc feeds
     Snapshot.verify(); (size, sha256) is the dedup identity for incremental
-    snapshots (collision-resistant, unlike crc) and can be knobbed off on
-    CPU-tight hosts that never pass ``base=``. sha256 over blake2b:
-    OpenSSL's implementation is ~2x faster per core here and releases the
-    GIL for large buffers, so the hash pool scales on multi-core hosts."""
+    snapshots (collision-resistant, unlike crc). ``want_sha`` is resolved
+    once per pipeline (``knobs.is_dedup_digests_enabled``: auto-gated on
+    CPU headroom, forced on when the take passes ``base=``). sha256 over
+    blake2b: OpenSSL's implementation is ~2x faster per core here and
+    releases the GIL for large buffers, so the hash pool scales on
+    multi-core hosts."""
     sha = None
-    if knobs.is_dedup_digests_enabled():
+    if want_sha:
         h = hashlib.sha256()
         h.update(mv)
         sha = h.hexdigest()
@@ -188,6 +190,11 @@ class _WritePipeline:
         # (root, {path: digest}, {(size, sha): path}) or None.
         self._base_loader = base_loader
         self._base_resolved = base_loader is None
+        # Resolved once per pipeline: a deferred background drain must not
+        # re-read a knob whose env changed since the take was planned.
+        self._want_sha = knobs.is_dedup_digests_enabled(
+            has_base=base_loader is not None
+        )
         self._base_lock = asyncio.Lock()
         self.base = None
         self.bytes_deduped = 0
@@ -308,9 +315,12 @@ class _WritePipeline:
                 digest = write_io.digest_out
                 if digest is None:
                     digest = await loop.run_in_executor(
-                        self._crc_executor, _digest_buffer, memoryview(buf)
+                        self._crc_executor,
+                        _digest_buffer,
+                        memoryview(buf),
+                        self._want_sha,
                     )
-                elif digest[2] is None and knobs.is_dedup_digests_enabled():
+                elif digest[2] is None and self._want_sha:
 
                     def sha_only(mv=memoryview(buf)):
                         h = hashlib.sha256()
@@ -325,7 +335,7 @@ class _WritePipeline:
                 self.checksums[path] = digest
                 return
             digest = await loop.run_in_executor(
-                self._crc_executor, _digest_buffer, memoryview(buf)
+                self._crc_executor, _digest_buffer, memoryview(buf), self._want_sha
             )
             self.checksums[path] = digest
             if digest[2] is not None:

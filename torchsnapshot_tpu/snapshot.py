@@ -92,6 +92,14 @@ logger = logging.getLogger(__name__)
 # take, per process.
 LAST_TAKE_PHASES: Dict[str, float] = {}
 
+# Stream-overlap accounting (wall/stage_busy/io_busy/overlap/idle, seconds)
+# of the most recent SYNC ``Snapshot.take``'s drain — the same decomposition
+# async takes expose via ``PendingSnapshot.drain_stats``, so a sync-take
+# throughput regression can be attributed to a stream (D2H+serialize vs
+# storage writes) rather than re-derived from wall clock. Diagnostics only:
+# overwritten per take, per process.
+LAST_SYNC_DRAIN_STATS: Dict[str, float] = {}
+
 
 class Snapshot:
     """A reference to a persisted snapshot at ``path``.
@@ -142,6 +150,8 @@ class Snapshot:
                 is_async_snapshot=False,
             )
             pending_io_work.sync_complete(event_loop)
+            LAST_SYNC_DRAIN_STATS.clear()
+            LAST_SYNC_DRAIN_STATS.update(pending_io_work.drain_stats)
             # Commit metadata only after ALL ranks finished writing data.
             coord.barrier()
             if coord.get_rank() == 0:
@@ -419,7 +429,8 @@ class Snapshot:
         )
         _phase("memory_budget")
         if base and not (
-            knobs.is_checksums_enabled() and knobs.is_dedup_digests_enabled()
+            knobs.is_checksums_enabled()
+            and knobs.is_dedup_digests_enabled(has_base=True)
         ):
             logger.warning(
                 "base=%s ignored: incremental dedup requires checksums and "
@@ -549,9 +560,28 @@ class Snapshot:
                 for k, v in merged.items()
                 if isinstance(v, list) and len(v) == 3 and v[2] is not None
             }
+            if digests and len(digests) < len(merged):
+                # Mixed coverage: some ranks of the base take recorded shas
+                # and others didn't (heterogeneous hosts under the auto
+                # gate, or knob churn between takes). Dedup still works for
+                # the covered objects; make the silent partial rewrite
+                # visible instead of letting the log imply full dedup.
+                logger.warning(
+                    "base=%s: %d of %d objects carry no sha256 dedup "
+                    "identity and will be rewritten (ranks of the base "
+                    "take disagreed on TORCHSNAPSHOT_TPU_DEDUP_DIGESTS — "
+                    "pin it to 1 on every host for full incremental dedup)",
+                    base,
+                    len(merged) - len(digests),
+                    len(merged),
+                )
             if not digests:
                 logger.warning(
-                    "base=%s carries no digest sidecars; taking a full snapshot",
+                    "base=%s carries no sha256 dedup identities (no sidecars, "
+                    "or its take ran with dedup digests off — the auto "
+                    "default on single-core hosts); taking a full snapshot. "
+                    "Pin TORCHSNAPSHOT_TPU_DEDUP_DIGESTS=1 for every take to "
+                    "checkpoint incrementally on such hosts",
                     base,
                 )
                 return None

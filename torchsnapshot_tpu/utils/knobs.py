@@ -319,11 +319,27 @@ def override_checksums(enabled: bool):
 _ENV_DEDUP_DIGESTS = "TORCHSNAPSHOT_TPU_DEDUP_DIGESTS"
 
 
-def is_dedup_digests_enabled() -> bool:
+def is_dedup_digests_enabled(has_base: bool = False) -> bool:
     """Record a sha256 per storage object alongside the CRC so the snapshot
-    can later serve as an incremental ``base``. sha256 costs ~1.3 GB/s/core
-    on top of crc32; disable on CPU-tight hosts that never use ``base=``."""
-    return os.environ.get(_ENV_DEDUP_DIGESTS, "1") not in ("0", "false", "False")
+    can later serve as an incremental ``base``.
+
+    Default ``auto``: enabled on multi-core hosts (a spare core hides the
+    hash behind the D2H/storage streams) and whenever the take itself
+    passes ``base=`` (the dedup identity is the point of that take);
+    disabled otherwise — on a single-vCPU host the hash competes with the
+    CPU-fed device transfer and was measured to cost 10-20% of sync-take
+    throughput (interference, not hash time: sha256 itself runs ~1.3
+    GB/s/core). ``1``/``0`` force it either way.
+
+    Caveat the auto mode implies: on a single-core host, a snapshot taken
+    WITHOUT ``base=`` carries no sha256s in its sidecars, so a later
+    ``take(base=that_snapshot)`` finds no dedup identities to match and
+    rewrites everything. Jobs that checkpoint incrementally on such hosts
+    should pin ``TORCHSNAPSHOT_TPU_DEDUP_DIGESTS=1`` for every take."""
+    val = os.environ.get(_ENV_DEDUP_DIGESTS, "auto").lower()
+    if val in ("auto", ""):
+        return has_base or _usable_cpu_count() > 1
+    return val not in ("0", "false", "off")
 
 
 def override_dedup_digests(enabled: bool):
