@@ -60,7 +60,7 @@ def _report(leg: str, tss, orbax) -> None:
     )
 
 
-def _run_sharded_leg(root: str, gb: float, reshard: bool) -> None:
+def _run_sharded_leg(root: str, gb: float, reshard: bool, reps: int = 2) -> None:
     """Params + adam moments on a (dp, tp) mesh; optionally restore into a
     TRANSPOSED layout (elasticity/resharding — the axis this library claims
     as its differentiation; orbax reshards via abstract targets)."""
@@ -155,23 +155,45 @@ def _run_sharded_leg(root: str, gb: float, reshard: bool) -> None:
         return stall, total, restore_s
 
     # Warmups (jit of defensive copies / tensorstore spinup), then
-    # INTERLEAVED reps on fresh states with best-of reporting: this host's
-    # page-cache writeback makes any single IO-heavy measurement noisy at
-    # the 2x level, and serial one-shot runs hand one library the bad
-    # window (same posture as bench.py's A/B medians).
+    # INTERLEAVED reps on fresh states with MEDIAN reporting (+ per-rep
+    # lines and restore spreads): this host's page-cache writeback makes
+    # any single IO-heavy measurement noisy at the 2x level, and serial
+    # one-shot runs hand one library the bad window (same posture as
+    # bench.py's A/B medians).
     Snapshot.async_take(os.path.join(root, "tss_warm"), {"m": StateDict(**warm)}).wait()
     _w = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     _w.save(os.path.join(root, "orbax_warm"), args=ocp.args.StandardSave(warm))
     _w.wait_until_finished()
     _w.close()
-    reps = 2
     tss_runs = []
     orbax_runs = []
     for rep in range(reps):
-        tss_runs.append(run_tss(build(10 + rep), tag=rep))
-        orbax_runs.append(run_orbax(build(20 + rep), tag=rep))
-    best = lambda runs: tuple(min(r[i] for r in runs) for i in range(3))  # noqa: E731
-    _report("reshard" if reshard else "sharded", best(tss_runs), best(orbax_runs))
+        # Alternate which library goes first so page-cache/writeback drift
+        # biases neither side.
+        if rep % 2 == 0:
+            tss_runs.append(run_tss(build(10 + rep), tag=rep))
+            orbax_runs.append(run_orbax(build(20 + rep), tag=rep))
+        else:
+            orbax_runs.append(run_orbax(build(20 + rep), tag=rep))
+            tss_runs.append(run_tss(build(10 + rep), tag=rep))
+        print(
+            f"rep {rep}: tss (stall/total/restore) "
+            f"{tss_runs[-1][0]:.3f}/{tss_runs[-1][1]:.2f}/{tss_runs[-1][2]:.2f} s, "
+            f"orbax {orbax_runs[-1][0]:.3f}/{orbax_runs[-1][1]:.2f}/{orbax_runs[-1][2]:.2f} s",
+            file=sys.stderr,
+        )
+    import statistics
+
+    med = lambda runs: tuple(  # noqa: E731
+        statistics.median(r[i] for r in runs) for i in range(3)
+    )
+    _report("reshard" if reshard else "sharded", med(tss_runs), med(orbax_runs))
+    for name, runs in (("tss", tss_runs), ("orbax", orbax_runs)):
+        print(
+            f"{name} restore spread: "
+            f"{min(r[2] for r in runs):.2f}-{max(r[2] for r in runs):.2f} s "
+            f"over {reps} interleaved reps",
+        )
 
 
 def _run_incremental_leg(root: str, gb: float) -> None:
@@ -256,6 +278,9 @@ def main() -> None:
     maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gb", type=float, default=0.5)
+    parser.add_argument(
+        "--reps", type=int, default=2, help="interleaved reps per library (sharded legs)"
+    )
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument(
         "--leg",
@@ -281,9 +306,13 @@ def main() -> None:
         root = tempfile.mkdtemp()
         try:
             if args.leg in ("sharded", "all"):
-                _run_sharded_leg(os.path.join(root, "sh"), args.gb, reshard=False)
+                _run_sharded_leg(
+                    os.path.join(root, "sh"), args.gb, reshard=False, reps=args.reps
+                )
             if args.leg in ("reshard", "all"):
-                _run_sharded_leg(os.path.join(root, "rs"), args.gb, reshard=True)
+                _run_sharded_leg(
+                    os.path.join(root, "rs"), args.gb, reshard=True, reps=args.reps
+                )
             if args.leg in ("incremental", "all"):
                 _run_incremental_leg(os.path.join(root, "inc"), args.gb)
         finally:

@@ -175,6 +175,35 @@ def test_budget_override_still_derives_local_world_size() -> None:
         knobs.set_local_world_size(1)
 
 
+def test_restore_overlap_auto_gate(monkeypatch) -> None:
+    """Default `auto`: overlap on with a spare core OR a real accelerator
+    backend; off only for the CPU backend on one core (dispatch starves);
+    forced values win. (The suite runs on the CPU backend, so
+    jax.default_backend() == 'cpu' here.)"""
+    from torchsnapshot_tpu.utils import knobs
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_RESTORE_OVERLAP", "auto")
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
+    assert knobs.is_restore_overlap_enabled() is False  # cpu backend, 1 core
+    # The round-5 headline: a real accelerator backend enables overlap even
+    # on a single core (H2D dispatch is a PJRT hand-off there).
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert knobs.is_restore_overlap_enabled() is True
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert knobs.is_restore_overlap_enabled() is False
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 8)
+    assert knobs.is_restore_overlap_enabled() is True
+
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_RESTORE_OVERLAP", "1")
+    assert knobs.is_restore_overlap_enabled() is True
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_RESTORE_OVERLAP", "off")
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 8)
+    assert knobs.is_restore_overlap_enabled() is False
+
+
 def test_dedup_digests_auto_gate(monkeypatch) -> None:
     """Default `auto`: sha256 dedup identities are recorded when a spare
     core can hide the hash, or when the take itself passes ``base=``;

@@ -109,3 +109,28 @@ def test_drain_stats_recorded(tmp_path) -> None:
     assert stats["idle_s"] >= 0
     # The snapshot itself is intact.
     assert snap.verify() == {}
+
+
+def test_sync_take_drain_stats_cover_staging(tmp_path) -> None:
+    """A SYNC take stages everything before its drain loop; the recorded
+    stream stats must still attribute that staging time (round-5: the
+    accounting moved into the shared wait loop so sync-take regressions
+    decompose the same way async drains do)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import Snapshot, StateDict, snapshot as snapshot_mod
+
+    arrs = {
+        f"a{i}": jax.random.normal(jax.random.PRNGKey(i), (256, 256), jnp.float32)
+        for i in range(4)
+    }
+    Snapshot.take(str(tmp_path / "ckpt"), {"m": StateDict(**arrs)})
+    stats = snapshot_mod.LAST_SYNC_DRAIN_STATS
+    assert {"wall_s", "stage_busy_s", "io_busy_s", "overlap_s", "idle_s"} == set(
+        stats
+    )
+    # The staging stream (device_get + serialize of 4 arrays) must be
+    # attributed, not reported as an empty stream.
+    assert stats["stage_busy_s"] > 0
+    assert stats["wall_s"] >= stats["stage_busy_s"] - 1e-6

@@ -221,11 +221,22 @@ class _WritePipeline:
         self.reporter = _ProgressReporter(rank, "write")
         self.checksums: Dict[str, list] = {}
         self._crc_executor: Optional[ThreadPoolExecutor] = None
-        # Populated by run_to_completion: how well the drain overlapped its
-        # two streams (D2H+serialize staging vs storage writes). The 7B-scale
-        # exposure is drain throughput, so the overlap efficiency must be
-        # observable, not asserted (see drain_stats keys there).
+        # Stream-activity accumulators, attributed at every wait-loop wakeup
+        # in BOTH run_until_staged and run_to_completion — a sync take does
+        # all its staging before the drain loop, so accounting only there
+        # would report an empty staging stream for exactly the takes whose
+        # regressions need attributing.
+        self._stage_busy = 0.0
+        self._io_busy = 0.0
+        self._overlap = 0.0
+        self._accounted_wall = 0.0
+        # Populated by run_to_completion: how well the pipeline overlapped
+        # its two streams (D2H+serialize staging vs storage writes). The
+        # 7B-scale exposure is drain throughput, so the overlap efficiency
+        # must be observable, not asserted. drain_stats covers the
+        # run_to_completion call only; pipeline_stats the whole pipeline.
         self.drain_stats: Dict[str, float] = {}
+        self.pipeline_stats: Dict[str, float] = {}
 
     def _report(self) -> None:
         self.reporter.maybe_report(
@@ -386,13 +397,19 @@ class _WritePipeline:
         try:
             if self.pending:
                 self._dispatch_staging()
+            last_ts = time.monotonic()
             while self.staging_tasks or self.pending:
+                staging_active = bool(self.staging_tasks)
+                io_active = bool(self.io_tasks)
                 done, _ = await asyncio.wait(
                     set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
                     # Bounded so the reporter fires during a stall (when no
                     # task completes, wait returns with done == set()).
                     timeout=self.reporter.interval_s,
+                )
+                last_ts = self._account_streams(
+                    last_ts, staging_active, io_active
                 )
                 self._reap(done)
                 self._dispatch_io()
@@ -407,17 +424,43 @@ class _WritePipeline:
         else:
             self._mark_staged()
 
+    def _account_streams(
+        self, last_ts: float, staging_active: bool, io_active: bool
+    ) -> float:
+        """Attribute the interval since ``last_ts`` to whichever streams had
+        work in flight when the wait began; returns the new timestamp."""
+        now = time.monotonic()
+        dt = now - last_ts
+        self._accounted_wall += dt
+        if staging_active:
+            self._stage_busy += dt
+        if io_active:
+            self._io_busy += dt
+        if staging_active and io_active:
+            self._overlap += dt
+        return now
+
     async def run_to_completion(self) -> None:
         """Drive the pipeline (staging and I/O) until everything is written."""
-        drain_t0 = last_ts = time.monotonic()
-        stage_busy = io_busy = overlap = 0.0
+        last_ts = time.monotonic()
+        # Accumulator snapshot at drain start: drain_stats reports THIS
+        # call's work only (for async takes, the background drain — any
+        # host-entry staging billed during the stall must not deflate the
+        # apparent drain rate), while pipeline_stats keeps the full union
+        # for sync takes.
+        base = (
+            self._accounted_wall,
+            self._stage_busy,
+            self._io_busy,
+            self._overlap,
+        )
         try:
             if self.pending or self.staging_tasks:
                 self._dispatch_staging()
             self._dispatch_io()
             while self.staging_tasks or self.pending or self.io_tasks or self.ready_for_io:
                 # Stream-activity snapshot for the interval we are about to
-                # sleep through: which of the two drain streams has work in
+                # sleep through: which of the two streams has work in
                 # flight. Attributed at wakeup.
                 staging_active = bool(self.staging_tasks)
                 io_active = bool(self.io_tasks)
@@ -428,15 +471,9 @@ class _WritePipeline:
                     # task completes, wait returns with done == set()).
                     timeout=self.reporter.interval_s,
                 )
-                now = time.monotonic()
-                dt = now - last_ts
-                last_ts = now
-                if staging_active:
-                    stage_busy += dt
-                if io_active:
-                    io_busy += dt
-                if staging_active and io_active:
-                    overlap += dt
+                last_ts = self._account_streams(
+                    last_ts, staging_active, io_active
+                )
                 self._reap(done)
                 self._dispatch_io()
                 self._dispatch_staging()
@@ -479,15 +516,29 @@ class _WritePipeline:
                     )
         finally:
             self._shutdown_executor()
-        wall = time.monotonic() - drain_t0
-        union_busy = stage_busy + io_busy - overlap
-        self.drain_stats = {
-            "wall_s": wall,
-            "stage_busy_s": stage_busy,  # D2H + serialize stream in flight
-            "io_busy_s": io_busy,  # storage-write stream in flight
-            "overlap_s": overlap,  # both streams concurrently in flight
-            "idle_s": max(0.0, wall - union_busy),  # neither stream active
-        }
+
+        def stats(wall: float, stage_busy: float, io_busy: float, overlap: float):
+            union_busy = stage_busy + io_busy - overlap
+            return {
+                "wall_s": wall,
+                "stage_busy_s": stage_busy,  # D2H + serialize stream in flight
+                "io_busy_s": io_busy,  # storage-write stream in flight
+                "overlap_s": overlap,  # both streams concurrently in flight
+                "idle_s": max(0.0, wall - union_busy),  # neither stream active
+            }
+
+        # drain_stats: this call only (the async background drain).
+        self.drain_stats = stats(
+            self._accounted_wall - base[0],
+            self._stage_busy - base[1],
+            self._io_busy - base[2],
+            self._overlap - base[3],
+        )
+        # pipeline_stats: run_until_staged + drain — the whole pipeline, so
+        # a SYNC take's staging (done before its drain loop) is attributed.
+        self.pipeline_stats = stats(
+            self._accounted_wall, self._stage_busy, self._io_busy, self._overlap
+        )
         elapsed = time.monotonic() - self.begin_ts
         if self.bytes_staged:
             dedup = (
@@ -495,14 +546,15 @@ class _WritePipeline:
                 if self.bytes_deduped
                 else ""
             )
-            # Overlap efficiency: how much of the shorter stream's busy time
-            # ran concurrently with the other stream. Low values mean the
-            # drain serialized D2H against storage writes — the tunable
-            # exposure at multi-GB scale.
-            shorter = min(stage_busy, io_busy)
-            efficiency = overlap / shorter if shorter > 0 else 1.0
+            # Overlap efficiency over the whole pipeline: how much of the
+            # shorter stream's busy time ran concurrently with the other
+            # stream. Low values mean D2H serialized against storage writes
+            # — the tunable exposure at multi-GB scale.
+            ps = self.pipeline_stats
+            shorter = min(ps["stage_busy_s"], ps["io_busy_s"])
+            efficiency = ps["overlap_s"] / shorter if shorter > 0 else 1.0
             logger.info(
-                "Rank %d wrote %.2f GB in %.2fs (%.2f GB/s)%s | drain %.2fs: "
+                "Rank %d wrote %.2f GB in %.2fs (%.2f GB/s)%s | pipeline %.2fs: "
                 "D2H/serialize busy %.2fs, storage busy %.2fs, overlapped "
                 "%.2fs (%.0f%% of shorter stream), idle %.2fs",
                 self.rank,
@@ -510,12 +562,12 @@ class _WritePipeline:
                 elapsed,
                 self.bytes_staged / 1e9 / max(elapsed, 1e-9),
                 dedup,
-                wall,
-                stage_busy,
-                io_busy,
-                overlap,
+                ps["wall_s"],
+                ps["stage_busy_s"],
+                ps["io_busy_s"],
+                ps["overlap_s"],
                 efficiency * 100,
-                self.drain_stats["idle_s"],
+                ps["idle_s"],
             )
 
     def _mark_staged(self) -> None:
@@ -554,8 +606,17 @@ class PendingIOWork:
     def drain_stats(self) -> Dict[str, float]:
         """Stream-overlap accounting of the completed drain (empty until
         ``complete`` finishes): wall_s, stage_busy_s, io_busy_s, overlap_s,
-        idle_s."""
+        idle_s. Covers the drain only — staging billed during the take's
+        stall (non-deferred host entries) is excluded, so bytes/wall_s is
+        an honest drain rate."""
         return dict(self._pipeline.drain_stats)
+
+    @property
+    def pipeline_stats(self) -> Dict[str, float]:
+        """Same keys, accumulated over the WHOLE pipeline (capture-point
+        staging + drain) — what a sync take should report, since its
+        staging completes before the drain loop ever runs."""
+        return dict(self._pipeline.pipeline_stats)
 
 
 async def execute_write_reqs(

@@ -151,7 +151,7 @@ class Snapshot:
             )
             pending_io_work.sync_complete(event_loop)
             LAST_SYNC_DRAIN_STATS.clear()
-            LAST_SYNC_DRAIN_STATS.update(pending_io_work.drain_stats)
+            LAST_SYNC_DRAIN_STATS.update(pending_io_work.pipeline_stats)
             # Commit metadata only after ALL ranks finished writing data.
             coord.barrier()
             if coord.get_rank() == 0:

@@ -372,14 +372,25 @@ def is_restore_overlap_enabled() -> bool:
     host buffers free eagerly so restore peak RSS tracks the memory budget
     rather than the state size.
 
-    Default ``auto``: enabled on multi-core hosts, disabled on single-vCPU
-    hosts — there, jax dispatch concurrent with the busy read pipeline
-    starves the PJRT worker thread (measured 2.5-10x slower restores on the
-    reshard workload) and overlap cannot win anyway (no spare core to
-    overlap onto). ``1``/``0`` force it either way."""
+    Default ``auto``: enabled on multi-core hosts, and on any host whose
+    default jax backend is a real accelerator — there the ``device_put``
+    dispatch hands off to the PJRT client (transfer-engine/network bound)
+    and overlap measured a ~1.5x restore win with lower peak RSS even on a
+    single vCPU (``benchmarks/restore_overlap/``). Disabled only for the
+    CPU *backend* on a single-vCPU host: CPU-backend dispatch executes the
+    copy on the host's only core and starves behind the busy read pipeline
+    (measured 2.5-10x slower restores on the reshard workload).
+    ``1``/``0`` force it either way."""
     val = os.environ.get(_ENV_RESTORE_OVERLAP, "auto").lower()
     if val in ("auto", ""):
-        return _usable_cpu_count() > 1
+        if _usable_cpu_count() > 1:
+            return True
+        try:
+            import jax
+
+            return jax.default_backend() != "cpu"
+        except Exception:  # pragma: no cover - jax not importable/initable
+            return False
     return val not in ("0", "false", "off")
 
 
