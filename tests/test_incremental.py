@@ -10,6 +10,7 @@ bytes. Deleting the base later must NOT invalidate the incremental.
 import os
 
 import numpy as np
+import pytest
 
 from torchsnapshot_tpu import Snapshot, StateDict
 from torchsnapshot_tpu.utils import knobs
@@ -205,3 +206,63 @@ def test_chained_incrementals(tmp_path) -> None:
         assert out["step"] == step
         assert np.array_equal(out["lora"], np.full((100,), step, np.float32))
         assert Snapshot(p).verify() == {}
+
+
+def _worker_multirank_incremental(rank: int, world_size: int, shared: str) -> None:
+    """2 coordinated ranks: replicated backbone (write-partitioned across
+    ranks) + per-rank adapters; the second take dedups the backbone via the
+    MERGED per-rank sidecars (an object may have been written by the peer)
+    and rewrites only the changed adapter."""
+    import os
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    base = os.path.join(shared, "inc_base")
+    nxt = os.path.join(shared, "inc_next")
+    backbone = {
+        f"w{i}": np.arange(4096, dtype=np.float32) + i for i in range(4)
+    }
+
+    def app(step: int):
+        return {
+            "m": StateDict(**backbone),
+            "a": StateDict(v=np.full((64,), rank * 100 + step, np.float32)),
+        }
+
+    Snapshot.take(base, app(0), replicated=["m/**"])
+    Snapshot.take(nxt, app(1), base=base, replicated=["m/**"])
+
+    if rank == 0:
+        for i in range(4):
+            b = os.path.join(base, "replicated", "m", f"w{i}")
+            n = os.path.join(nxt, "replicated", "m", f"w{i}")
+            assert os.path.exists(n), n
+            assert os.path.samefile(b, n), f"backbone w{i} must hard-link"
+        for r in range(world_size):
+            vb = os.path.join(base, str(r), "a", "v")
+            vn = os.path.join(nxt, str(r), "a", "v")
+            assert not os.path.samefile(vb, vn), "changed adapter must rewrite"
+
+    # Both ranks restore the incremental and see step-1 state.
+    tgt = {
+        "m": StateDict(**{k: np.zeros_like(v) for k, v in backbone.items()}),
+        "a": StateDict(v=np.zeros((64,), np.float32)),
+    }
+    Snapshot(nxt).restore(tgt)
+    for k, v in backbone.items():
+        assert np.array_equal(tgt["m"][k], v)
+    assert np.array_equal(
+        tgt["a"]["v"], np.full((64,), rank * 100 + 1, np.float32)
+    )
+    assert Snapshot(nxt).verify() == {}
+
+
+@pytest.mark.multiprocess
+def test_multirank_incremental_dedup(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    run_with_processes(
+        _worker_multirank_incremental, nproc=2, args=(str(tmp_path),)
+    )

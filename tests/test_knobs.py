@@ -186,13 +186,16 @@ def test_restore_overlap_auto_gate(monkeypatch) -> None:
     monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
     assert knobs.is_restore_overlap_enabled() is False  # cpu backend, 1 core
     # The round-5 headline: a real accelerator backend enables overlap even
-    # on a single core (H2D dispatch is a PJRT hand-off there).
+    # on a single core (H2D dispatch is a PJRT hand-off there). The backend
+    # is consulted only when the restore has live jax targets — a
+    # numpy-only restore must never initialize PJRT from a knob read.
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert knobs.is_restore_overlap_enabled() is True
+    assert knobs.is_restore_overlap_enabled(has_jax_targets=True) is True
+    assert knobs.is_restore_overlap_enabled(has_jax_targets=False) is False
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
-    assert knobs.is_restore_overlap_enabled() is False
+    assert knobs.is_restore_overlap_enabled(has_jax_targets=True) is False
     monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 8)
     assert knobs.is_restore_overlap_enabled() is True
 

@@ -480,6 +480,9 @@ class _WritePipeline:
                 self._report()
                 if not self.staging_tasks and not self.pending:
                     self._mark_staged()
+            # Reset the interval so the sidecar storage op below is
+            # attributed from here, not from the last loop wakeup.
+            last_ts = time.monotonic()
             if self.checksums:
                 # Pre-commit (the caller barriers before rank 0 writes the
                 # metadata file), so a committed snapshot always carries its
@@ -514,6 +517,10 @@ class _WritePipeline:
                         self.rank,
                         exc_info=True,
                     )
+            # The sidecar write/delete is real storage time: bill it to the
+            # io stream so wall_s (and the drain rate derived from it)
+            # doesn't silently exclude the post-loop tail.
+            self._account_streams(last_ts, False, True)
         finally:
             self._shutdown_executor()
 

@@ -694,7 +694,14 @@ class Snapshot:
         # no spare core even inline overlap loses (jax dispatch starves
         # behind GIL-holding consumers), hence the auto gate; gated off,
         # finalizers run phase-split after the pipeline.
-        overlap = knobs.is_restore_overlap_enabled()
+        # The hint keeps a numpy-only restore from consulting (and thereby
+        # initializing) the jax backend inside the knob; live device
+        # targets imply jax is already up, making the backend probe free.
+        overlap = knobs.is_restore_overlap_enabled(
+            has_jax_targets=any(
+                _is_jax_array(v) for v in live_flattened.values()
+            )
+        )
         finalizers: Dict[int, Callable[[], None]] = {}
         deferred_finalizers: List[Callable[[], None]] = []
         frame_tables = _fetch_frame_tables(

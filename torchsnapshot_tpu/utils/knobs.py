@@ -366,25 +366,31 @@ def override_plan_cache(enabled: bool):
 _ENV_RESTORE_OVERLAP = "TORCHSNAPSHOT_TPU_RESTORE_OVERLAP"
 
 
-def is_restore_overlap_enabled() -> bool:
+def is_restore_overlap_enabled(has_jax_targets: bool = False) -> bool:
     """Finalize each restored entry (its host→device transfer) as its last
     read consumes — H2D overlaps the storage reads still in flight, and
     host buffers free eagerly so restore peak RSS tracks the memory budget
     rather than the state size.
 
-    Default ``auto``: enabled on multi-core hosts, and on any host whose
-    default jax backend is a real accelerator — there the ``device_put``
-    dispatch hands off to the PJRT client (transfer-engine/network bound)
-    and overlap measured a ~1.5x restore win with lower peak RSS even on a
-    single vCPU (``benchmarks/restore_overlap/``). Disabled only for the
-    CPU *backend* on a single-vCPU host: CPU-backend dispatch executes the
-    copy on the host's only core and starves behind the busy read pipeline
-    (measured 2.5-10x slower restores on the reshard workload).
-    ``1``/``0`` force it either way."""
+    Default ``auto``: enabled on multi-core hosts, and — when the restore
+    actually has live jax device targets (``has_jax_targets``) — on any
+    host whose default jax backend is a real accelerator: there the
+    ``device_put`` dispatch hands off to the PJRT client (transfer-engine/
+    network bound) and overlap measured a ~1.5x restore win with lower
+    peak RSS even on a single vCPU (``benchmarks/restore_overlap/``).
+    Disabled for the CPU *backend* on a single-vCPU host: CPU-backend
+    dispatch executes the copy on the host's only core and starves behind
+    the busy read pipeline (measured 2.5-10x slower restores on the
+    reshard workload). The backend is only consulted when
+    ``has_jax_targets`` is True — live device targets imply jax is already
+    initialized, so a numpy-only restore never triggers PJRT backend
+    initialization from a knob read. ``1``/``0`` force it either way."""
     val = os.environ.get(_ENV_RESTORE_OVERLAP, "auto").lower()
     if val in ("auto", ""):
         if _usable_cpu_count() > 1:
             return True
+        if not has_jax_targets:
+            return False
         try:
             import jax
 
