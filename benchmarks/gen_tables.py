@@ -58,6 +58,14 @@ def render_headline_table(src: str, bench: dict) -> str:
         f"| async-take train-step stall, steady-state | **{d['async_stall_s']:.3f} s** |",
         f"| async-take stall, first take (incl. XLA compile) | {d['async_stall_cold_s']:.3f} s |",
         f"| Background drain (D2H + storage I/O) | {d['background_drain_s']:.2f} s |",
+    ]
+    if d.get("drain_vs_link") is not None:
+        lines += [
+            f"| Drain rate vs link rate bracketing it | {d['drain_gbps']:.4f} / "
+            f"{d['link_gbps_around_drain']:.4f} GB/s = **{d['drain_vs_link']:.2f}x** "
+            "(>= 0.85 means the staging stream saturates the transfer) |",
+        ]
+    lines += [
         f"| Reference-equivalent stall on this link | >= {d['ref_equiv_stall_s']:.1f} s "
         f"(**~{round(parsed['vs_baseline'])}x**) |",
         f"| Sync take vs naive blocking save | {ab} |",
