@@ -266,3 +266,21 @@ def test_multirank_incremental_dedup(tmp_path) -> None:
     run_with_processes(
         _worker_multirank_incremental, nproc=2, args=(str(tmp_path),)
     )
+
+
+def test_auto_gate_single_core_writes_crc_only_sidecars(tmp_path, monkeypatch) -> None:
+    """The round-5 default on a single-core host: takes still write checksum
+    sidecars (verify() stays green) but with no sha256 — the dedup identity
+    whose hashing was measured to steal the core feeding the device
+    transfer."""
+    import json
+
+    monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEDUP_DIGESTS", "auto")
+    monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(path, {"m": _state(0)})
+    with open(os.path.join(path, ".checksums.0")) as f:
+        sidecar = json.load(f)
+    assert sidecar
+    assert all(v[2] is None for v in sidecar.values()), sidecar
+    assert Snapshot(path).verify() == {}

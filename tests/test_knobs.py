@@ -227,3 +227,47 @@ def test_dedup_digests_auto_gate(monkeypatch) -> None:
     monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 1)
     monkeypatch.setenv("TORCHSNAPSHOT_TPU_DEDUP_DIGESTS", "1")
     assert knobs.is_dedup_digests_enabled() is True
+
+
+def test_numpy_only_restore_never_initializes_jax_backend(tmp_path) -> None:
+    """Reading the restore-overlap knob must not initialize a PJRT backend
+    as a side effect: on TPU hosts libtpu is an exclusive client, so a
+    numpy-only restore that silently grabbed the device could break a
+    concurrently running trainer. Run in a fresh subprocess (the suite's
+    own jax backend is long since initialized)."""
+    import subprocess
+    import sys
+
+    script = """
+import os, sys
+try:
+    # Pin to one core so the knob's single-core branch (the one that must
+    # NOT consult jax) is exercised on any CI host, not just 1-vCPU boxes.
+    os.sched_setaffinity(0, {next(iter(os.sched_getaffinity(0)))})
+except (AttributeError, OSError):
+    pass
+import numpy as np
+from torchsnapshot_tpu import Snapshot, StateDict
+
+root = sys.argv[1]
+app = {"m": StateDict(w=np.arange(256, dtype=np.float32))}
+Snapshot.take(os.path.join(root, "ck"), app)
+tgt = {"m": StateDict(w=np.zeros(256, dtype=np.float32))}
+Snapshot(os.path.join(root, "ck")).restore(tgt)
+assert np.array_equal(tgt["m"]["w"], np.arange(256, dtype=np.float32))
+import jax._src.xla_bridge as xb
+assert not xb._backends, f"restore initialized jax backends: {list(xb._backends)}"
+print("OK")
+"""
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
