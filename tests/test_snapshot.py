@@ -197,3 +197,27 @@ def test_pickle_dtype_roundtrip(tmp_path) -> None:
     assert out["objs"][0] == {"a": 1} and out["objs"][1] is None
     got = Snapshot(path).read_object("0/s/dates")
     assert np.array_equal(got, dates)
+
+
+def test_retake_same_path_with_shrunk_state(tmp_path) -> None:
+    """Re-taking to an existing path (rotating checkpoint dirs) must yield a
+    snapshot that reads as ONLY the new state: entries dropped between takes
+    disappear from the manifest (their orphaned objects are inert), restore
+    sees the new values, read_object of a removed key raises, and verify()
+    stays green against the new sidecars."""
+    import pytest
+
+    path = str(tmp_path / "ckpt")
+    Snapshot.take(
+        path,
+        {"m": StateDict(a=np.arange(64, dtype=np.float32), b=np.ones(32))},
+    )
+    Snapshot.take(path, {"m": StateDict(a=np.full(64, 7, dtype=np.float32))})
+
+    out = StateDict()
+    Snapshot(path).restore({"m": out})
+    assert np.array_equal(out["a"], np.full(64, 7, dtype=np.float32))
+    assert "b" not in out
+    with pytest.raises(KeyError):
+        Snapshot(path).read_object("0/m/b")
+    assert Snapshot(path).verify() == {}
