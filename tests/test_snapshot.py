@@ -214,6 +214,10 @@ def test_retake_same_path_with_shrunk_state(tmp_path) -> None:
     )
     Snapshot.take(path, {"m": StateDict(a=np.full(64, 7, dtype=np.float32))})
 
+    # The orphaned object persists on disk (take does not wipe the
+    # destination) — it is INERT, not deleted: unreferenced by the new
+    # manifest, invisible to restore/read_object, ignored by verify.
+    assert (tmp_path / "ckpt" / "0" / "m" / "b").exists()
     out = StateDict()
     Snapshot(path).restore({"m": out})
     assert np.array_equal(out["a"], np.full(64, 7, dtype=np.float32))
