@@ -690,10 +690,14 @@ class Snapshot:
         # on the reshard workload: finalizing on an executor thread (round
         # 3: 12x slower — jax dispatch off the main thread) and running the
         # pipeline on a background thread with a main-thread finalizer pump
-        # (round 4: 2.5x slower — cross-thread loop wakeups). On hosts with
-        # no spare core even inline overlap loses (jax dispatch starves
-        # behind GIL-holding consumers), hence the auto gate; gated off,
-        # finalizers run phase-split after the pipeline.
+        # (round 4: 2.5x slower — cross-thread loop wakeups). On CPU-backend
+        # hosts with no spare core even inline overlap loses (the copy
+        # executes on the host's only core and starves behind GIL-holding
+        # consumers) — but with a real accelerator backend the device_put
+        # is a PJRT hand-off and overlap WINS 1.5x even on one core
+        # (round 5, benchmarks/restore_overlap/), hence the platform-aware
+        # auto gate; gated off, finalizers run phase-split after the
+        # pipeline.
         # The hint keeps a numpy-only restore from consulting (and thereby
         # initializing) the jax backend inside the knob; live device
         # targets imply jax is already up, making the backend probe free.
