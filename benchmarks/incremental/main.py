@@ -22,6 +22,11 @@ from benchmarks.common import maybe_init_distributed  # noqa: E402
 
 
 def main() -> None:
+    # Pin dedup digests ON: the auto default disables them on single-vCPU
+    # hosts, and a base taken without sha256 identities silently degrades
+    # every incremental take to a full rewrite — this benchmark would then
+    # "pass" while measuring nothing (ADVICE round 5).
+    os.environ["TORCHSNAPSHOT_TPU_DEDUP_DIGESTS"] = "1"
     maybe_init_distributed()
     parser = argparse.ArgumentParser()
     parser.add_argument("--frozen-gb", type=float, default=1.0)
@@ -66,6 +71,16 @@ def main() -> None:
         f"incremental take: {total_gb:.2f} GB state, {changed_gb:.3f} GB "
         f"changed, {inc_s:.2f}s ({full_s / inc_s:.1f}x faster than full)"
     )
+
+    # Hard-linking must actually have happened: a silent fallback to full
+    # rewrites (digests missing, cross-device link failure) would otherwise
+    # report a bogus "speedup". Same inode == same bytes on disk.
+    loc = Snapshot(os.path.join(root, "step1")).get_manifest()[
+        "0/m/backbone0"
+    ].location
+    assert os.path.samefile(
+        os.path.join(root, "step0", loc), os.path.join(root, "step1", loc)
+    ), "backbone object was rewritten, not hard-linked — dedup silently degraded"
 
     out = StateDict()
     Snapshot(os.path.join(root, "step1")).restore({"m": out})

@@ -221,6 +221,11 @@ def _run_incremental_leg(root: str, gb: float) -> None:
         return state
 
     def run_tss():
+        # Pin dedup digests ON for both takes: the auto default turns them
+        # off on single-vCPU hosts, and a base without sha256 identities
+        # silently degrades the second take to a full rewrite — this leg
+        # would then compare orbax against nothing (ADVICE round 5).
+        os.environ["TORCHSNAPSHOT_TPU_DEDUP_DIGESTS"] = "1"
         s0 = build(0, step=0)
         p0 = os.path.join(root, "tss_step0")
         t0 = time.perf_counter()
@@ -231,6 +236,12 @@ def _run_incremental_leg(root: str, gb: float) -> None:
         t0 = time.perf_counter()
         Snapshot.take(p1, {"m": StateDict(**s1)}, base=p0)
         incr_s = time.perf_counter() - t0
+        # The claimed speedup is only real if the frozen objects were
+        # hard-linked, not rewritten; same inode proves it.
+        loc = Snapshot(p1).get_manifest()["0/m/frozen_0"].location
+        assert os.path.samefile(
+            os.path.join(p0, loc), os.path.join(p1, loc)
+        ), "frozen object was rewritten, not hard-linked — dedup silently degraded"
         tgt = StateDict(**{k: jnp.zeros_like(v) for k, v in s1.items()})
         t0 = time.perf_counter()
         Snapshot(p1).restore({"m": tgt})

@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from torchsnapshot_tpu.utils import knobs
 
 
@@ -196,6 +198,44 @@ def test_restore_overlap_auto_gate(monkeypatch) -> None:
     assert knobs.is_restore_overlap_enabled(has_jax_targets=False) is False
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert knobs.is_restore_overlap_enabled(has_jax_targets=True) is False
+    # Target-derived gate (preferred over the default backend): the restore
+    # passes the platforms of the TARGET arrays' shard devices — a set or a
+    # lazily-evaluated callable. Accelerator-only targets enable overlap
+    # even when the default backend is cpu; mixed cpu+accelerator targets
+    # disable it (the cpu-bound finalizers would still starve the core).
+    assert (
+        knobs.is_restore_overlap_enabled(
+            has_jax_targets=True, target_platforms={"tpu"}
+        )
+        is True
+    )
+    assert (
+        knobs.is_restore_overlap_enabled(
+            has_jax_targets=True, target_platforms=lambda: {"tpu"}
+        )
+        is True
+    )
+    assert (
+        knobs.is_restore_overlap_enabled(
+            has_jax_targets=True, target_platforms={"cpu", "tpu"}
+        )
+        is False
+    )
+    assert (
+        knobs.is_restore_overlap_enabled(
+            has_jax_targets=True, target_platforms={"cpu"}
+        )
+        is False
+    )
+    # Empty set: no shard devices discovered — fall back to the backend.
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert (
+        knobs.is_restore_overlap_enabled(
+            has_jax_targets=True, target_platforms=set()
+        )
+        is True
+    )
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     monkeypatch.setattr(knobs, "_usable_cpu_count", lambda: 8)
     assert knobs.is_restore_overlap_enabled() is True
 
@@ -255,9 +295,22 @@ Snapshot.take(os.path.join(root, "ck"), app)
 tgt = {"m": StateDict(w=np.zeros(256, dtype=np.float32))}
 Snapshot(os.path.join(root, "ck")).restore(tgt)
 assert np.array_equal(tgt["m"]["w"], np.arange(256, dtype=np.float32))
-import jax._src.xla_bridge as xb
-assert not xb._backends, f"restore initialized jax backends: {list(xb._backends)}"
-print("OK")
+# Preferred signal: "jax" absent from sys.modules proves no backend could
+# have initialized at all (the restore path must not even import jax for a
+# numpy-only restore knob read). If something else imported jax, fall back
+# to the private xla_bridge registry — guarded, since jax moves private
+# names across releases (ADVICE round 5).
+if "jax" not in sys.modules:
+    print("OK (jax never imported)")
+else:
+    import jax._src.xla_bridge as xb
+    backends = getattr(xb, "_backends", None)
+    if backends is None:
+        # The private attr moved; we can't assert either way on this jax.
+        print("OK-SKIPPED (jax._src.xla_bridge._backends not present)")
+    else:
+        assert not backends, f"restore initialized jax backends: {list(backends)}"
+        print("OK")
 """
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -271,3 +324,8 @@ print("OK")
     )
     assert proc.returncode == 0, proc.stderr
     assert "OK" in proc.stdout
+    if "OK-SKIPPED" in proc.stdout:
+        pytest.skip(
+            "jax._src.xla_bridge._backends not present in this jax release; "
+            "backend-initialization could not be asserted"
+        )

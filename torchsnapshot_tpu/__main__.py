@@ -14,6 +14,14 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         CRC32-audit every storage object against the recorded sidecars;
         exit code 1 if any problem is found.
 
+    python -m torchsnapshot_tpu trace <snapshot-path> [-o trace.json]
+        Traced read of every storage object the manifest references, under
+        the usual memory budget + IO concurrency caps; writes a Chrome/
+        Perfetto trace (open at https://ui.perfetto.dev) and prints the
+        slowest objects + the metrics summary. The per-object spans come
+        from the storage plugin itself, so what you see is what a restore
+        pays per request.
+
 Works against any storage URL the library supports (local path, gs://,
 s3://).
 """
@@ -83,6 +91,76 @@ def _cmd_verify(args: argparse.Namespace) -> int:
     return 1
 
 
+def _cmd_trace(args: argparse.Namespace) -> int:
+    import asyncio
+
+    from . import telemetry
+    from .io_types import ReadIO
+    from .snapshot import Snapshot, _manifest_storage_locations
+    from .storage_plugin import url_to_storage_plugin_in_event_loop
+    from .utils import knobs
+
+    tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm)
+    event_loop = asyncio.new_event_loop()
+    try:
+        snap = Snapshot(args.path)
+        storage = url_to_storage_plugin_in_event_loop(args.path, event_loop)
+        try:
+            with telemetry.span("trace.read_metadata", cat="cli"):
+                metadata = snap._read_metadata(storage, event_loop)
+            locations = sorted(_manifest_storage_locations(metadata.manifest))
+
+            async def read_all() -> int:
+                # Object sizes aren't known before the read, so the memory
+                # guard is a conservative one: at most 8 whole-object reads
+                # in flight (each treated as one-eighth of the budget),
+                # further capped by the IO-concurrency knob — tracing a
+                # snapshot of 512 MB shards can't OOM a small operator VM.
+                sem = asyncio.Semaphore(
+                    min(8, knobs.get_max_concurrent_io_for(storage))
+                )
+                total = 0
+
+                async def read_one(path: str) -> None:
+                    nonlocal total
+                    async with sem:
+                        read_io = ReadIO(path=path)
+                        await storage.read(read_io)
+                        total += read_io.buf.getbuffer().nbytes
+
+                await asyncio.gather(*(read_one(p) for p in locations))
+                return total
+
+            with telemetry.span(
+                "trace.read_objects", cat="cli", objects=len(locations)
+            ):
+                total = event_loop.run_until_complete(read_all())
+        finally:
+            storage.sync_close(event_loop)
+    finally:
+        telemetry.deactivate(tm, prev)
+        event_loop.close()
+
+    telemetry.write_chrome_trace(tm, args.output)
+    reads = sorted(
+        tm.spans(name="storage.read"), key=lambda s: -(s.dur or 0.0)
+    )
+    print(f"read {len(locations)} object(s), {total / 1e9:.3f} GB")
+    for sp in reads[:10]:
+        print(
+            f"  {sp.dur or 0.0:8.3f}s  {sp.attrs.get('nbytes', 0) / 1e6:10.2f} MB"
+            f"  {sp.attrs.get('path', '?')}"
+        )
+    metrics = tm.metrics.as_dict()
+    if metrics:
+        print("metrics:")
+        for k in sorted(metrics):
+            print(f"  {k} = {metrics[k]}")
+    print(f"trace written to {args.output} (open at https://ui.perfetto.dev)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m torchsnapshot_tpu",
@@ -103,6 +181,19 @@ def main(argv=None) -> int:
     p_verify = sub.add_parser("verify", help="CRC32-audit the snapshot")
     p_verify.add_argument("path")
     p_verify.set_defaults(fn=_cmd_verify)
+
+    p_trace = sub.add_parser(
+        "trace",
+        help="traced read of the snapshot; writes a Perfetto trace JSON",
+    )
+    p_trace.add_argument("path")
+    p_trace.add_argument(
+        "-o",
+        "--output",
+        default="trace.json",
+        help="Chrome/Perfetto trace-event JSON destination (default: trace.json)",
+    )
+    p_trace.set_defaults(fn=_cmd_trace)
 
     args = parser.parse_args(argv)
     try:

@@ -48,6 +48,7 @@ from .serialization import (
     Serializer,
     array_nbytes,
 )
+from . import telemetry
 from .utils import knobs
 from .utils.lru import BoundedLRU
 
@@ -192,7 +193,7 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         import numpy as np
 
-        from .io_preparers.array import to_host
+        from .io_preparers.array import _traced_to_host
 
         arrs = tuple(req.buffer_stager.arr for req, _, _ in self.members)
         key = _pack_key(arrs)
@@ -213,10 +214,13 @@ class DeviceBatchedBufferStager(BatchedBufferStager):
             return await super().stage_buffer(executor)
         try:
             packed = _pack_to_device_bytes(key, arrs)
-            # to_host wraps the async-hint-then-resolve pattern; a device-side
-            # failure (e.g. async HBM OOM from the pack's allocation)
-            # surfaces at the resolve and falls back too.
-            host = await to_host(packed, executor)()
+            # _traced_to_host wraps the async-hint-then-resolve pattern (plus
+            # a d2h telemetry span when tracing); a device-side failure
+            # (e.g. async HBM OOM from the pack's allocation) surfaces at
+            # the resolve and falls back too.
+            host = await _traced_to_host(
+                packed, executor, self.members[0][0].path, self.total
+            )
             if host.nbytes != self.total:
                 raise RuntimeError(
                     f"Device-packed slab is {host.nbytes} bytes, "
@@ -515,6 +519,22 @@ def batch_write_requests(
             compressed=True,
         )
 
+    # Plan metrics: how much the batcher coalesced. Every original request
+    # not in the final passthrough joined a slab; the slab count excludes
+    # .ftab side objects so the ratio is members-per-slab, not per-write.
+    slabs = len(
+        {
+            r.path
+            for r in batched_reqs
+            if not r.path.endswith(_FRAME_TABLE_SUFFIX)
+        }
+    )
+    coalesced = len(write_reqs) - len(passthrough)
+    telemetry.counter_add("batcher.write_members", coalesced)
+    telemetry.counter_add("batcher.write_slabs", slabs)
+    if slabs:
+        telemetry.gauge_set("batcher.write_coalescing_ratio", coalesced / slabs)
+
     return entries, passthrough + batched_reqs
 
 
@@ -609,4 +629,8 @@ def batch_read_requests(
                 run = []
             run.append(req)
         close_run()
+    # Plan metrics: merged-away reads per merge pass (requests in minus
+    # requests out = storage round-trips the merge saved).
+    telemetry.counter_add("batcher.read_reqs_in", len(read_reqs))
+    telemetry.counter_add("batcher.read_reqs_merged", len(read_reqs) - len(out))
     return out

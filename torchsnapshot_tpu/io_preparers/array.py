@@ -26,6 +26,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry
 from ..serialization import (
@@ -74,6 +75,22 @@ def to_host(arr: Any, executor: Optional[Executor] = None):
         return np.asarray(arr)
 
     return resolve
+
+
+async def _traced_to_host(
+    arr: Any, executor: Optional[Executor], location: str, nbytes: int
+) -> np.ndarray:
+    """:func:`to_host` under a ``d2h`` telemetry span (+ bytes/seconds
+    metrics). Free None-check when no session is active — the span branch
+    never runs on untraced takes."""
+    tm = telemetry.get_active()
+    if tm is None:
+        return await to_host(arr, executor)()
+    with tm.span("d2h", "d2h", path=location, nbytes=nbytes) as sp:
+        host = await to_host(arr, executor)()
+    tm.metrics.counter("d2h.bytes").add(nbytes)
+    tm.metrics.histogram("d2h.seconds").observe(sp.span.dur or 0.0)
+    return host
 
 
 class ArrayBufferStager(BufferStager):
@@ -127,7 +144,9 @@ class ArrayBufferStager(BufferStager):
         serializer = Serializer.RAW if self.stage_raw else self.entry.serializer
         arr = self.arr
         if _is_jax_array(arr):
-            host = await to_host(arr, executor)()
+            host = await _traced_to_host(
+                arr, executor, self.entry.location, _nbytes_of(arr)
+            )
         else:
             host = np.asarray(arr)
             if (

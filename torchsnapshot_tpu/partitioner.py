@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from . import telemetry
 from .io_types import WriteReq
 from .manifest import Entry, Manifest, is_replicated
 from .parallel.coordinator import Coordinator
@@ -142,10 +143,26 @@ def partition_write_reqs_with_assignment(
         if payload_path in assignment:
             assignment[partner] = assignment[payload_path]
 
+    _record_balance_metrics(loads, rank)
+
     return (
         other_reqs + [r for r in replicated_reqs if assignment[r.path] == rank],
         assignment,
     )
+
+
+def _record_balance_metrics(loads: List[int], rank: int) -> None:
+    """Per-rank byte-balance gauges: a skewed post-assignment load means the
+    slowest rank gates the commit barrier — observable, not guessed-at."""
+    if telemetry.get_active() is None:
+        return
+    total = sum(loads)
+    telemetry.gauge_set("partitioner.local_load_bytes", loads[rank])
+    telemetry.gauge_set("partitioner.load_max_bytes", max(loads))
+    telemetry.gauge_set("partitioner.load_min_bytes", min(loads))
+    mean = total / len(loads) if loads else 0
+    if mean > 0:
+        telemetry.gauge_set("partitioner.load_balance", max(loads) / mean)
 
 
 def consolidate_replicated_entries(global_manifest: Manifest) -> None:

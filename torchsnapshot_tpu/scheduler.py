@@ -49,10 +49,88 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import psutil
 
+from . import telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .utils import knobs
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Interval algebra for the stream-overlap stats. The pipelines record one
+# (t0, t1) interval per staging/io task — the same data telemetry exports as
+# scheduler stage/io spans — and the drain/pipeline stats are DERIVED from
+# those intervals by union/intersection, so the trace and the stats can
+# never disagree about where the time went.
+# ---------------------------------------------------------------------------
+
+def _merge_intervals(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Sorted union of possibly-overlapping intervals."""
+    out: List[Tuple[float, float]] = []
+    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
+        if out and t0 <= out[-1][1]:
+            if t1 > out[-1][1]:
+                out[-1] = (out[-1][0], t1)
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _clip_merged(
+    merged: List[Tuple[float, float]], w0: float, w1: float
+) -> List[Tuple[float, float]]:
+    return [
+        (max(t0, w0), min(t1, w1)) for t0, t1 in merged if t1 > w0 and t0 < w1
+    ]
+
+
+def _measure(merged: List[Tuple[float, float]]) -> float:
+    return sum(t1 - t0 for t0, t1 in merged)
+
+
+def _intersect_merged(
+    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        t0 = max(a[i][0], b[j][0])
+        t1 = min(a[i][1], b[j][1])
+        if t1 > t0:
+            out.append((t0, t1))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _stream_stats(
+    windows: List[Tuple[float, float]],
+    stage_intervals: List[Tuple[float, float]],
+    io_intervals: List[Tuple[float, float]],
+) -> Dict[str, float]:
+    """wall/stage_busy/io_busy/overlap/idle over the given accounting
+    windows. Only activity inside a window is attributed (matching the old
+    wait-loop accounting: the gap between an async take's capture point and
+    its background drain is nobody's time)."""
+    stage = _merge_intervals(stage_intervals)
+    io = _merge_intervals(io_intervals)
+    both = _intersect_merged(stage, io)
+    wall = stage_busy = io_busy = overlap = 0.0
+    for w0, w1 in windows:
+        wall += w1 - w0
+        stage_busy += _measure(_clip_merged(stage, w0, w1))
+        io_busy += _measure(_clip_merged(io, w0, w1))
+        overlap += _measure(_clip_merged(both, w0, w1))
+    union = stage_busy + io_busy - overlap
+    return {
+        "wall_s": wall,
+        "stage_busy_s": stage_busy,  # D2H + serialize stream in flight
+        "io_busy_s": io_busy,  # storage-write stream in flight
+        "overlap_s": overlap,  # both streams concurrently in flight
+        "idle_s": max(0.0, wall - union),  # neither stream active
+    }
 
 CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
@@ -120,12 +198,21 @@ class _Budget:
     def __init__(self, total: int) -> None:
         self.total = total
         self.available = total
+        # Lowest availability seen — the budget high-water mark
+        # (total - min_available) is a telemetry gauge at pipeline end.
+        self.min_available = total
 
     def debit(self, n: int) -> None:
         self.available -= n
+        if self.available < self.min_available:
+            self.min_available = self.available
 
     def credit(self, n: int) -> None:
         self.available += n
+
+    @property
+    def high_water_bytes(self) -> int:
+        return self.total - self.min_available
 
 
 class _ProgressReporter:
@@ -212,31 +299,57 @@ class _WritePipeline:
         # Staged only after run_until_staged's capture point (see
         # WriteReq.defer_staging).
         self.deferred: List[WriteReq] = [r for r in by_size if r.defer_staging]
-        self.staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int]] = {}
+        self.staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int, float]] = {}
         self.ready_for_io: Deque[Tuple[str, object]] = deque()
-        self.io_tasks: Dict[asyncio.Task, int] = {}
+        self.io_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
         self.bytes_staged = 0
         self.staged_ts: Optional[float] = None
         self.executor: Optional[ThreadPoolExecutor] = None
         self.reporter = _ProgressReporter(rank, "write")
         self.checksums: Dict[str, list] = {}
         self._crc_executor: Optional[ThreadPoolExecutor] = None
-        # Stream-activity accumulators, attributed at every wait-loop wakeup
-        # in BOTH run_until_staged and run_to_completion — a sync take does
-        # all its staging before the drain loop, so accounting only there
-        # would report an empty staging stream for exactly the takes whose
-        # regressions need attributing.
-        self._stage_busy = 0.0
-        self._io_busy = 0.0
-        self._overlap = 0.0
-        self._accounted_wall = 0.0
+        # Per-task (t0, t1) intervals for the two streams, recorded in BOTH
+        # run_until_staged and run_to_completion — a sync take does all its
+        # staging before the drain loop, so recording only there would
+        # report an empty staging stream for exactly the takes whose
+        # regressions need attributing. When a telemetry session is active
+        # the same intervals are also exported as scheduler.stage /
+        # scheduler.io spans; disabled, they stay plain tuples (no Span
+        # allocation on the hot path).
+        self._tm = telemetry.get_active()
+        self._stage_intervals: List[Tuple[float, float]] = []
+        self._io_intervals: List[Tuple[float, float]] = []
+        # Accounting windows: the wait loops' [start, end] spans. Stats
+        # attribute only in-window activity (the async gap between capture
+        # point and background drain is nobody's time).
+        self._windows: List[Tuple[float, float]] = []
         # Populated by run_to_completion: how well the pipeline overlapped
         # its two streams (D2H+serialize staging vs storage writes). The
         # 7B-scale exposure is drain throughput, so the overlap efficiency
         # must be observable, not asserted. drain_stats covers the
         # run_to_completion call only; pipeline_stats the whole pipeline.
+        # Both are derived views over the recorded stream intervals (the
+        # same data the telemetry trace exports as spans).
         self.drain_stats: Dict[str, float] = {}
         self.pipeline_stats: Dict[str, float] = {}
+
+    def _record_task(self, kind: str, t0: float, path: str, nbytes: int) -> None:
+        """One finished staging/io task: record its interval (stats) and,
+        when telemetry is on, the corresponding scheduler span."""
+        t1 = time.monotonic()
+        if kind == "stage":
+            self._stage_intervals.append((t0, t1))
+        else:
+            self._io_intervals.append((t0, t1))
+        tm = self._tm
+        if tm is not None:
+            tm.add_span(
+                f"scheduler.{kind}",
+                "scheduler",
+                t0,
+                t1 - t0,
+                {"path": path, "nbytes": nbytes, "rank": self.rank},
+            )
 
     def _report(self) -> None:
         self.reporter.maybe_report(
@@ -265,7 +378,7 @@ class _WritePipeline:
             req = self.pending.popleft()
             self.budget.debit(cost)
             task = asyncio.ensure_future(req.buffer_stager.stage_buffer(self.executor))
-            self.staging_tasks[task] = (req, cost)
+            self.staging_tasks[task] = (req, cost, time.monotonic())
 
     def _dispatch_io(self) -> None:
         max_io = knobs.get_max_concurrent_io_for(self.storage)
@@ -273,7 +386,7 @@ class _WritePipeline:
             path, buf = self.ready_for_io.popleft()
             nbytes = memoryview(buf).nbytes
             task = asyncio.ensure_future(self._write_one(path, buf))
-            self.io_tasks[task] = nbytes
+            self.io_tasks[task] = (nbytes, time.monotonic(), path)
 
     async def _write_one(self, path: str, buf) -> None:
         if knobs.is_checksums_enabled():
@@ -376,17 +489,19 @@ class _WritePipeline:
     def _reap(self, done) -> None:
         for task in done:
             if task in self.staging_tasks:
-                req, cost = self.staging_tasks.pop(task)
+                req, cost, t0 = self.staging_tasks.pop(task)
                 buf = task.result()
                 nbytes = memoryview(buf).nbytes
+                self._record_task("stage", t0, req.path, nbytes)
                 self.bytes_staged += nbytes
                 # Correct the estimate to the real footprint.
                 self.budget.credit(cost)
                 self.budget.debit(nbytes)
                 self.ready_for_io.append((req.path, buf))
             else:
-                nbytes = self.io_tasks.pop(task)
+                nbytes, t0, path = self.io_tasks.pop(task)
                 task.result()  # propagate failures
+                self._record_task("io", t0, path, nbytes)
                 self.budget.credit(nbytes)
 
     async def run_until_staged(self) -> None:
@@ -394,22 +509,17 @@ class _WritePipeline:
         request's bytes are privately held in host RAM. Deferred requests
         (immutable device-backed data) then join the queue for the
         background drain."""
+        window_t0 = time.monotonic()
         try:
             if self.pending:
                 self._dispatch_staging()
-            last_ts = time.monotonic()
             while self.staging_tasks or self.pending:
-                staging_active = bool(self.staging_tasks)
-                io_active = bool(self.io_tasks)
                 done, _ = await asyncio.wait(
                     set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
                     # Bounded so the reporter fires during a stall (when no
                     # task completes, wait returns with done == set()).
                     timeout=self.reporter.interval_s,
-                )
-                last_ts = self._account_streams(
-                    last_ts, staging_active, io_active
                 )
                 self._reap(done)
                 self._dispatch_io()
@@ -418,52 +528,26 @@ class _WritePipeline:
         except BaseException:
             self._shutdown_executor()
             raise
+        finally:
+            self._windows.append((window_t0, time.monotonic()))
         if self.deferred:
             self.pending.extend(self.deferred)
             self.deferred = []
         else:
             self._mark_staged()
 
-    def _account_streams(
-        self, last_ts: float, staging_active: bool, io_active: bool
-    ) -> float:
-        """Attribute the interval since ``last_ts`` to whichever streams had
-        work in flight when the wait began; returns the new timestamp."""
-        now = time.monotonic()
-        dt = now - last_ts
-        self._accounted_wall += dt
-        if staging_active:
-            self._stage_busy += dt
-        if io_active:
-            self._io_busy += dt
-        if staging_active and io_active:
-            self._overlap += dt
-        return now
-
     async def run_to_completion(self) -> None:
         """Drive the pipeline (staging and I/O) until everything is written."""
-        last_ts = time.monotonic()
-        # Accumulator snapshot at drain start: drain_stats reports THIS
-        # call's work only (for async takes, the background drain — any
-        # host-entry staging billed during the stall must not deflate the
-        # apparent drain rate), while pipeline_stats keeps the full union
-        # for sync takes.
-        base = (
-            self._accounted_wall,
-            self._stage_busy,
-            self._io_busy,
-            self._overlap,
-        )
+        # Window bookkeeping: drain_stats reports THIS call's window only
+        # (for async takes, the background drain — any host-entry staging
+        # billed during the stall must not deflate the apparent drain
+        # rate), while pipeline_stats covers every window for sync takes.
+        drain_t0 = time.monotonic()
         try:
             if self.pending or self.staging_tasks:
                 self._dispatch_staging()
             self._dispatch_io()
             while self.staging_tasks or self.pending or self.io_tasks or self.ready_for_io:
-                # Stream-activity snapshot for the interval we are about to
-                # sleep through: which of the two streams has work in
-                # flight. Attributed at wakeup.
-                staging_active = bool(self.staging_tasks)
-                io_active = bool(self.io_tasks)
                 done, _ = await asyncio.wait(
                     set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
@@ -471,26 +555,28 @@ class _WritePipeline:
                     # task completes, wait returns with done == set()).
                     timeout=self.reporter.interval_s,
                 )
-                last_ts = self._account_streams(
-                    last_ts, staging_active, io_active
-                )
                 self._reap(done)
                 self._dispatch_io()
                 self._dispatch_staging()
                 self._report()
                 if not self.staging_tasks and not self.pending:
                     self._mark_staged()
-            # Reset the interval so the sidecar storage op below is
-            # attributed from here, not from the last loop wakeup.
-            last_ts = time.monotonic()
+            # The sidecar write/delete below is real storage time: recorded
+            # as an io interval so wall_s (and the drain rate derived from
+            # it) doesn't silently exclude the post-loop tail.
+            sidecar_t0 = time.monotonic()
             if self.checksums:
                 # Pre-commit (the caller barriers before rank 0 writes the
                 # metadata file), so a committed snapshot always carries its
                 # checksum sidecars.
                 payload = json.dumps(self.checksums, sort_keys=True).encode()
                 self.checksums = {}
+                sidecar_path = f"{CHECKSUM_FILE_PREFIX}{self.rank}"
                 await self.storage.write(
-                    WriteIO(path=f"{CHECKSUM_FILE_PREFIX}{self.rank}", buf=payload)
+                    WriteIO(path=sidecar_path, buf=payload)
+                )
+                self._record_task(
+                    "io", sidecar_t0, sidecar_path, len(payload)
                 )
             else:
                 # No sidecar written this take (checksums off, or this rank
@@ -517,35 +603,27 @@ class _WritePipeline:
                         self.rank,
                         exc_info=True,
                     )
-            # The sidecar write/delete is real storage time: bill it to the
-            # io stream so wall_s (and the drain rate derived from it)
-            # doesn't silently exclude the post-loop tail.
-            self._account_streams(last_ts, False, True)
         finally:
             self._shutdown_executor()
 
-        def stats(wall: float, stage_busy: float, io_busy: float, overlap: float):
-            union_busy = stage_busy + io_busy - overlap
-            return {
-                "wall_s": wall,
-                "stage_busy_s": stage_busy,  # D2H + serialize stream in flight
-                "io_busy_s": io_busy,  # storage-write stream in flight
-                "overlap_s": overlap,  # both streams concurrently in flight
-                "idle_s": max(0.0, wall - union_busy),  # neither stream active
-            }
-
-        # drain_stats: this call only (the async background drain).
-        self.drain_stats = stats(
-            self._accounted_wall - base[0],
-            self._stage_busy - base[1],
-            self._io_busy - base[2],
-            self._overlap - base[3],
+        drain_window = (drain_t0, time.monotonic())
+        self._windows.append(drain_window)
+        # drain_stats: this call's window only (the async background drain).
+        self.drain_stats = _stream_stats(
+            [drain_window], self._stage_intervals, self._io_intervals
         )
         # pipeline_stats: run_until_staged + drain — the whole pipeline, so
         # a SYNC take's staging (done before its drain loop) is attributed.
-        self.pipeline_stats = stats(
-            self._accounted_wall, self._stage_busy, self._io_busy, self._overlap
+        self.pipeline_stats = _stream_stats(
+            self._windows, self._stage_intervals, self._io_intervals
         )
+        # Pipeline-level metrics (no-ops unless a telemetry session is on).
+        telemetry.gauge_max(
+            "scheduler.budget_hwm_bytes", self.budget.high_water_bytes
+        )
+        telemetry.counter_add("scheduler.bytes_staged", self.bytes_staged)
+        if self.bytes_deduped:
+            telemetry.counter_add("scheduler.bytes_deduped", self.bytes_deduped)
         elapsed = time.monotonic() - self.begin_ts
         if self.bytes_staged:
             dedup = (
@@ -675,11 +753,12 @@ async def execute_read_reqs(
     pending: Deque[ReadReq] = deque(
         sorted(read_reqs, key=lambda r: -r.buffer_consumer.get_consuming_cost_bytes())
     )
-    io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int]] = {}
-    consume_tasks: Dict[asyncio.Task, int] = {}
+    io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int, float]] = {}
+    consume_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
     bytes_read = 0
     executor = ThreadPoolExecutor(max_workers=knobs.get_consuming_threads())
     reporter = _ProgressReporter(rank, "read")
+    tm = telemetry.get_active()
 
     async def read_one(req: ReadReq) -> object:
         read_io = ReadIO(path=req.path, byte_range=req.byte_range)
@@ -696,7 +775,11 @@ async def execute_read_reqs(
                 break
             req = pending.popleft()
             budget.debit(cost)
-            io_tasks[asyncio.ensure_future(read_one(req))] = (req, cost)
+            io_tasks[asyncio.ensure_future(read_one(req))] = (
+                req,
+                cost,
+                time.monotonic(),
+            )
 
     try:
         dispatch_reads()
@@ -708,17 +791,34 @@ async def execute_read_reqs(
             )
             for task in done:
                 if task in io_tasks:
-                    req, cost = io_tasks.pop(task)
+                    req, cost, t0 = io_tasks.pop(task)
                     buf = task.result()
-                    bytes_read += memoryview(buf).nbytes
+                    nbytes = memoryview(buf).nbytes
+                    bytes_read += nbytes
+                    if tm is not None:
+                        tm.add_span(
+                            "scheduler.read_io",
+                            "scheduler",
+                            t0,
+                            time.monotonic() - t0,
+                            {"path": req.path, "nbytes": nbytes, "rank": rank},
+                        )
                     consume_tasks[
                         asyncio.ensure_future(
                             req.buffer_consumer.consume_buffer(buf, executor)
                         )
-                    ] = cost
+                    ] = (cost, time.monotonic(), req.path)
                 else:
-                    cost = consume_tasks.pop(task)
+                    cost, t0, path = consume_tasks.pop(task)
                     task.result()
+                    if tm is not None:
+                        tm.add_span(
+                            "scheduler.consume",
+                            "scheduler",
+                            t0,
+                            time.monotonic() - t0,
+                            {"path": path, "rank": rank},
+                        )
                     budget.credit(cost)
             dispatch_reads()
             reporter.maybe_report(
@@ -734,6 +834,8 @@ async def execute_read_reqs(
         executor.shutdown(wait=False)
 
     elapsed = time.monotonic() - begin_ts
+    telemetry.counter_add("scheduler.bytes_read", bytes_read)
+    telemetry.gauge_max("scheduler.budget_hwm_bytes", budget.high_water_bytes)
     if bytes_read:
         logger.info(
             "Rank %d read %.2f GB in %.2fs (%.2f GB/s)",

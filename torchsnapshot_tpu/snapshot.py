@@ -36,7 +36,6 @@ import fnmatch
 import logging
 import os
 import threading
-import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -79,6 +78,7 @@ from .scheduler import (
 )
 from .stateful import AppState, Stateful
 from .storage_plugin import url_to_storage_plugin_in_event_loop
+from . import telemetry
 from .utils import knobs
 from .version import __version__
 
@@ -86,7 +86,8 @@ logger = logging.getLogger(__name__)
 
 # Stall decomposition of this process's most recent take/async_take: phase
 # name -> seconds (gather_keys_and_flatten, prepare_write, partition,
-# d2h_hint, manifest_gather, memory_budget, capture). The stall IS these
+# d2h_hint, manifest_gather, memory_budget, capture). Derived from the
+# telemetry phase spans (``telemetry.PhaseTracker``) — the stall IS these
 # phases — device bytes drain in the background — so regressions here are
 # regressions of the headline metric. Diagnostics only: overwritten per
 # take, per process.
@@ -101,6 +102,46 @@ LAST_TAKE_PHASES: Dict[str, float] = {}
 LAST_SYNC_DRAIN_STATS: Dict[str, float] = {}
 
 
+def _begin_telemetry(
+    explicit: Optional["telemetry.Telemetry"],
+) -> Tuple[Optional["telemetry.Telemetry"], Optional["telemetry.Telemetry"]]:
+    """Start a telemetry session for one take/restore: an explicit
+    ``_telemetry=`` object wins, else ``TORCHSNAPSHOT_TPU_TRACE`` creates
+    one, else no session (and the instrumented paths cost one None-check).
+    Returns (session, previously-active session)."""
+    tm = explicit
+    if tm is None and knobs.get_trace_path():
+        tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm) if tm is not None else None
+    return tm, prev
+
+
+def _finish_telemetry(
+    tm: Optional["telemetry.Telemetry"],
+    prev: Optional["telemetry.Telemetry"],
+    rank: int,
+) -> None:
+    """Close a session: restore the previous activation, publish it as
+    ``Snapshot.last_telemetry``, and write the Chrome/Perfetto trace if the
+    trace knob is set (rank 0 writes the path verbatim; other ranks append
+    ``.rank<N>`` so one shared filesystem path never interleaves). A trace
+    write failure degrades to a warning — never a failed checkpoint."""
+    if tm is None:
+        return
+    tm.rank = rank
+    telemetry.deactivate(tm, prev)
+    Snapshot.last_telemetry = tm
+    trace_path = knobs.get_trace_path()
+    if trace_path:
+        path = trace_path if rank == 0 else f"{trace_path}.rank{rank}"
+        try:
+            telemetry.write_chrome_trace(tm, path)
+        except Exception:  # noqa: BLE001 - diagnostics must not fail the op
+            logger.warning(
+                "failed to write telemetry trace to %s", path, exc_info=True
+            )
+
+
 class Snapshot:
     """A reference to a persisted snapshot at ``path``.
 
@@ -112,6 +153,11 @@ class Snapshot:
         snapshot = Snapshot("/checkpoints/step_1000")
         snapshot.restore(app_state)
     """
+
+    # Telemetry session of this process's most recent completed
+    # take/async_take/restore that had one (explicit ``_telemetry=`` or the
+    # TORCHSNAPSHOT_TPU_TRACE knob). Diagnostics only; overwritten per op.
+    last_telemetry: Optional["telemetry.Telemetry"] = None
 
     def __init__(self, path: str, coordinator: Optional[Coordinator] = None) -> None:
         self.path = path
@@ -127,6 +173,7 @@ class Snapshot:
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         base: Optional[str] = None,
+        _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "Snapshot":
         """``base``: path of an earlier snapshot for an INCREMENTAL take —
         storage objects byte-identical to the base (matched by size +
@@ -135,34 +182,46 @@ class Snapshot:
         back to a full write. Hard links share inodes, so the base may be
         deleted later without invalidating this snapshot. Near-free
         checkpoints when most state is frozen (LoRA/partial finetunes,
-        embedding-heavy models)."""
+        embedding-heavy models).
+
+        ``_telemetry``: a :class:`telemetry.Telemetry` session to record
+        this take's spans/metrics into (semi-public; the stable switch is
+        the ``TORCHSNAPSHOT_TPU_TRACE`` knob). The session is also
+        published as ``Snapshot.last_telemetry``."""
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
-        plan = cls._plan_take(path, app_state, coord, replicated or [], base)
-        event_loop = asyncio.new_event_loop()
-        storage = url_to_storage_plugin_in_event_loop(plan.path, event_loop)
+        tm, tm_prev = _begin_telemetry(_telemetry)
         try:
-            pending_io_work, metadata = cls._take_impl(
-                plan=plan,
-                coord=coord,
-                storage=storage,
-                event_loop=event_loop,
-                is_async_snapshot=False,
-            )
-            pending_io_work.sync_complete(event_loop)
-            LAST_SYNC_DRAIN_STATS.clear()
-            LAST_SYNC_DRAIN_STATS.update(pending_io_work.pipeline_stats)
-            # Commit metadata only after ALL ranks finished writing data.
-            coord.barrier()
-            if coord.get_rank() == 0:
-                cls._write_snapshot_metadata(metadata, storage, event_loop)
-            # ...and return only after the commit is visible: otherwise a
-            # non-zero rank could immediately open the path for restore and
-            # race rank 0's metadata write.
-            coord.barrier()
+            plan = cls._plan_take(path, app_state, coord, replicated or [], base)
+            event_loop = asyncio.new_event_loop()
+            storage = url_to_storage_plugin_in_event_loop(plan.path, event_loop)
+            try:
+                pending_io_work, metadata = cls._take_impl(
+                    plan=plan,
+                    coord=coord,
+                    storage=storage,
+                    event_loop=event_loop,
+                    is_async_snapshot=False,
+                )
+                pending_io_work.sync_complete(event_loop)
+                LAST_SYNC_DRAIN_STATS.clear()
+                LAST_SYNC_DRAIN_STATS.update(pending_io_work.pipeline_stats)
+                # Commit metadata only after ALL ranks finished writing data.
+                with telemetry.span("take.commit", cat="take"):
+                    coord.barrier()
+                    if coord.get_rank() == 0:
+                        cls._write_snapshot_metadata(
+                            metadata, storage, event_loop
+                        )
+                    # ...and return only after the commit is visible:
+                    # otherwise a non-zero rank could immediately open the
+                    # path for restore and race rank 0's metadata write.
+                    coord.barrier()
+            finally:
+                storage.sync_close(event_loop)
+                event_loop.close()
         finally:
-            storage.sync_close(event_loop)
-            event_loop.close()
+            _finish_telemetry(tm, tm_prev, coord.get_rank())
         snapshot = cls(path=plan.path, coordinator=coord)
         snapshot._metadata = metadata
         return snapshot
@@ -175,6 +234,7 @@ class Snapshot:
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         base: Optional[str] = None,
+        _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "PendingSnapshot":
         """Returns after planning + forking device buffers (milliseconds);
         device→host transfer, storage I/O, and the atomic commit all happen on
@@ -185,25 +245,34 @@ class Snapshot:
         all data in host RAM before returning, ``snapshot.py:245-314``)
         because jax arrays are immutable: an on-device fork detaches the
         snapshot from subsequent donation, so the train-step stall is
-        planning time only, independent of checkpoint size."""
+        planning time only, independent of checkpoint size.
+
+        A telemetry session (``_telemetry=`` or the TORCHSNAPSHOT_TPU_TRACE
+        knob) stays active through the background drain and closes — and
+        the trace file is written — when the snapshot commits."""
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
-        plan = cls._plan_take(path, app_state, coord, replicated or [], base)
-        event_loop = asyncio.new_event_loop()
-        storage = url_to_storage_plugin_in_event_loop(plan.path, event_loop)
+        tm, tm_prev = _begin_telemetry(_telemetry)
         try:
-            pending_io_work, metadata = cls._take_impl(
-                plan=plan,
-                coord=coord,
-                storage=storage,
-                event_loop=event_loop,
-                is_async_snapshot=True,
-            )
+            plan = cls._plan_take(path, app_state, coord, replicated or [], base)
+            event_loop = asyncio.new_event_loop()
+            storage = url_to_storage_plugin_in_event_loop(plan.path, event_loop)
+            try:
+                pending_io_work, metadata = cls._take_impl(
+                    plan=plan,
+                    coord=coord,
+                    storage=storage,
+                    event_loop=event_loop,
+                    is_async_snapshot=True,
+                )
+            except BaseException:
+                # On planning/staging failure no PendingSnapshot exists to
+                # own cleanup; close here or the loop + plugin threads leak.
+                storage.sync_close(event_loop)
+                event_loop.close()
+                raise
         except BaseException:
-            # On planning/staging failure no PendingSnapshot exists to own
-            # cleanup; close here or the loop + plugin threads leak.
-            storage.sync_close(event_loop)
-            event_loop.close()
+            _finish_telemetry(tm, tm_prev, coord.get_rank())
             raise
         return PendingSnapshot(
             path=plan.path,
@@ -212,6 +281,8 @@ class Snapshot:
             metadata=metadata,
             storage=storage,
             event_loop=event_loop,
+            tm=tm,
+            tm_prev=tm_prev,
         )
 
     @classmethod
@@ -247,8 +318,9 @@ class Snapshot:
             probe_plan,
         )
 
-        phases: Dict[str, float] = {}
-        t0 = time.monotonic()
+        # Phase boundaries are telemetry spans; the legacy LAST_TAKE_PHASES
+        # dict is derived from the same tracker at the end of _take_impl.
+        tracker = telemetry.PhaseTracker(cat="take.phase")
 
         # Snapshot the mapping itself: a stateful whose state_dict() mutates
         # the caller's app_state dict must not perturb this iteration.
@@ -275,9 +347,7 @@ class Snapshot:
             mnfst, flat = flatten(sd, prefix=key)
             manifest.update(mnfst)
             flattened.update(flat)
-        now = time.monotonic()
-        phases["gather_keys_and_flatten"] = now - t0
-        t0 = now
+        tracker.mark("gather_keys_and_flatten")
 
         # Fingerprint + cache probe only matter at world > 1 (preflight
         # bypasses the collectives entirely at world 1 and plans are never
@@ -307,7 +377,7 @@ class Snapshot:
             plan_token=cached.token if cached is not None else None,
             keys_sig=keys_sig,
         )
-        phases["preflight"] = time.monotonic() - t0
+        tracker.mark("preflight")
         return TakePlan(
             path=pf.path,
             base=pf.base,
@@ -318,7 +388,7 @@ class Snapshot:
             fingerprint=fingerprint,
             cache_hit=pf.hit,
             cached=cached if pf.hit else None,
-            phases=phases,
+            phase_tracker=tracker,
         )
 
     @classmethod
@@ -335,14 +405,14 @@ class Snapshot:
         rank = coord.get_rank()
         world_size = coord.get_world_size()
         base = plan.base
-        phases: Dict[str, float] = plan.phases
-        phase_t0 = time.monotonic()
+        # Continue the planning tracker: the gap since its last mark
+        # (plugin construction, event-loop creation) lands in the next
+        # phase, so the decomposition COVERS the stall instead of leaking
+        # un-phased time (test_stall_decomposition's coverage assertion).
+        tracker = plan.phase_tracker or telemetry.PhaseTracker(cat="take.phase")
 
         def _phase(name: str) -> None:
-            nonlocal phase_t0
-            now = time.monotonic()
-            phases[name] = now - phase_t0
-            phase_t0 = now
+            tracker.mark(name)
 
         manifest: Manifest = dict(plan.manifest)
         flattened = plan.flattened
@@ -478,7 +548,7 @@ class Snapshot:
         for _, stateful, state in rng_states:
             stateful.load_state_dict(state)
         LAST_TAKE_PHASES.clear()
-        LAST_TAKE_PHASES.update(phases)
+        LAST_TAKE_PHASES.update(tracker.durations)
         return pending_io_work, metadata
 
     @classmethod
@@ -590,18 +660,24 @@ class Snapshot:
             storage.sync_close(event_loop)
 
     # --------------------------------------------------------------- restore
-    def restore(self, app_state: AppState) -> None:
+    def restore(
+        self,
+        app_state: AppState,
+        _telemetry: Optional["telemetry.Telemetry"] = None,
+    ) -> None:
         self._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
         rank = coord.get_rank()
+        tm, tm_prev = _begin_telemetry(_telemetry)
         # Before any storage IO: the metadata read below would otherwise
         # freeze the FS plugin's O_DIRECT stream cap at the unscaled default
         # in a fresh (restore-only) process.
         memory_budget = get_process_memory_budget_bytes(coord)
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
         try:
-            metadata = self._read_metadata(storage, event_loop)
+            with telemetry.span("restore.read_metadata", cat="restore"):
+                metadata = self._read_metadata(storage, event_loop)
             manifest = get_manifest_for_rank(metadata, rank)
             # One-pass prefix index: bucket entries by their FIRST path
             # segment so per-key planning below is O(bucket), not
@@ -633,14 +709,17 @@ class Snapshot:
             ]
             for key in [k for k in keys if k not in rng_keys] + rng_keys:
                 if key in app_state:
-                    self._load_stateful(
-                        key=key,
-                        stateful=app_state[key],
-                        manifest=by_first_seg.get(key.partition("/")[0], {}),
-                        storage=storage,
-                        memory_budget=memory_budget,
-                        event_loop=event_loop,
-                    )
+                    with telemetry.span(
+                        "restore.load_stateful", cat="restore", key=key
+                    ):
+                        self._load_stateful(
+                            key=key,
+                            stateful=app_state[key],
+                            manifest=by_first_seg.get(key.partition("/")[0], {}),
+                            storage=storage,
+                            memory_budget=memory_budget,
+                            event_loop=event_loop,
+                        )
             # Single post-load barrier: no rank observes restore() as
             # complete (and e.g. deletes/overwrites the snapshot, or
             # reports readiness) while a peer is still reading storage.
@@ -648,6 +727,7 @@ class Snapshot:
         finally:
             storage.sync_close(event_loop)
             event_loop.close()
+            _finish_telemetry(tm, tm_prev, rank)
 
     def _load_stateful(
         self,
@@ -700,11 +780,24 @@ class Snapshot:
         # pipeline.
         # The hint keeps a numpy-only restore from consulting (and thereby
         # initializing) the jax backend inside the knob; live device
-        # targets imply jax is already up, making the backend probe free.
+        # targets imply jax is already up, making the platform probe free.
+        # The gate derives from the TARGET arrays' shard devices (callable:
+        # evaluated only on the knob's single-core branch), not the
+        # process-default backend — they disagree exactly when a CPU-default
+        # process restores onto an explicitly-addressed accelerator.
+        def _target_platforms() -> Set[str]:
+            platforms: Set[str] = set()
+            for v in live_flattened.values():
+                if _is_jax_array(v):
+                    for d in v.sharding.device_set:
+                        platforms.add(getattr(d, "platform", "cpu"))
+            return platforms
+
         overlap = knobs.is_restore_overlap_enabled(
             has_jax_targets=any(
                 _is_jax_array(v) for v in live_flattened.values()
-            )
+            ),
+            target_platforms=_target_platforms,
         )
         finalizers: Dict[int, Callable[[], None]] = {}
         deferred_finalizers: List[Callable[[], None]] = []
@@ -1490,11 +1583,18 @@ class PendingSnapshot:
         metadata: SnapshotMetadata,
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
+        tm: Optional["telemetry.Telemetry"] = None,
+        tm_prev: Optional["telemetry.Telemetry"] = None,
     ) -> None:
         self.path = path
         self._coord = coord
         self._metadata = metadata
         self._pending_io_work = pending_io_work
+        # Telemetry session opened by async_take; closed (and the trace
+        # written) when the background commit finishes, so drain spans land
+        # in the same trace as the stall's planning phases.
+        self._tm = tm
+        self._tm_prev = tm_prev
         PendingSnapshot._seq += 1
         self._barrier_id = f"async_commit/{PendingSnapshot._seq}/{path}"
         self._exc: Optional[BaseException] = None
@@ -1543,6 +1643,7 @@ class PendingSnapshot:
                 event_loop.close()
             except Exception:
                 pass
+            _finish_telemetry(self._tm, self._tm_prev, rank)
             self._done.set()
 
     def wait(self) -> Snapshot:

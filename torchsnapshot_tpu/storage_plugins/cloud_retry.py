@@ -12,6 +12,8 @@ import logging
 import random
 import time
 
+from .. import telemetry
+
 logger = logging.getLogger(__name__)
 
 BASE_BACKOFF_S = 0.5
@@ -65,6 +67,12 @@ async def retry_transient(run, is_transient, progress: CollectiveProgress, label
                 raise
             attempt += 1
             backoff = backoff_s(attempt)
+            # Observability for flaky links: how often the plugins retried
+            # and how long they slept doing it (per-plugin via the label).
+            telemetry.counter_add(f"cloud_retry.{label.lower()}.retries")
+            telemetry.counter_add(
+                f"cloud_retry.{label.lower()}.backoff_s", backoff
+            )
             logger.warning(
                 "Transient %s error (attempt %d, retrying in %.1fs while "
                 "the plugin makes collective progress): %s",

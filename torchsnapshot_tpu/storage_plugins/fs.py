@@ -24,9 +24,15 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
-import aiofiles
+try:
+    import aiofiles
+except ImportError:  # pragma: no cover - environment-dependent
+    # Gated, not required: containers without aiofiles fall back to blocking
+    # file I/O on the plugin's executor (same thread pool the native engine
+    # uses), preserving the async plugin contract.
+    aiofiles = None
 
-from .. import native
+from .. import native, telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..utils import knobs
 
@@ -82,6 +88,18 @@ class FSStoragePlugin(StoragePlugin):
         )
 
     async def write(self, write_io: WriteIO) -> None:
+        nbytes = memoryview(write_io.buf).nbytes
+        with telemetry.span(
+            "storage.write",
+            cat="storage",
+            plugin="fs",
+            path=write_io.path,
+            nbytes=nbytes,
+        ):
+            await self._write_inner(write_io, nbytes)
+        telemetry.counter_add("storage.fs.write_bytes", nbytes)
+
+    async def _write_inner(self, write_io: WriteIO, nbytes: int) -> None:
         path = os.path.join(self.root, write_io.path)
         self._ensure_parent(path)
         # Write-then-rename so a crash mid-write can never leave a truncated
@@ -89,7 +107,6 @@ class FSStoragePlugin(StoragePlugin):
         # presence IS the commit marker (object stores give this per-PUT).
         tmp_path = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
         try:
-            nbytes = memoryview(write_io.buf).nbytes
             if self._use_native(nbytes):
                 lib = self._native
                 # The crc digest rides the write loop (chunk-hot hashing in
@@ -123,9 +140,18 @@ class FSStoragePlugin(StoragePlugin):
                 await asyncio.get_event_loop().run_in_executor(
                     self._get_executor(), work
                 )
-            else:
+            elif aiofiles is not None:
                 async with aiofiles.open(tmp_path, "wb") as f:
                     await f.write(write_io.buf)
+            else:
+
+                def buffered_write() -> None:
+                    with open(tmp_path, "wb") as f:
+                        f.write(write_io.buf)
+
+                await asyncio.get_event_loop().run_in_executor(
+                    self._get_executor(), buffered_write
+                )
             os.replace(tmp_path, path)
         except BaseException:
             with contextlib.suppress(OSError):
@@ -138,6 +164,16 @@ class FSStoragePlugin(StoragePlugin):
         an exotic filesystem all return False and the caller writes the
         bytes instead. Hard links share the inode, so deleting the base
         snapshot later does NOT invalidate this one."""
+        with telemetry.span(
+            "storage.link_in", cat="storage", plugin="fs", path=path
+        ) as sp:
+            ok = self._link_in_inner(src_abs_path, path)
+            sp.set_attrs(linked=ok)
+        if ok:
+            telemetry.counter_add("storage.fs.link_in_count")
+        return ok
+
+    def _link_in_inner(self, src_abs_path: str, path: str) -> bool:
         dst = os.path.join(self.root, path)
         tmp = f"{dst}.tmp.{uuid.uuid4().hex[:8]}"
         try:
@@ -154,6 +190,18 @@ class FSStoragePlugin(StoragePlugin):
             return False
 
     async def read(self, read_io: ReadIO) -> None:
+        with telemetry.span(
+            "storage.read",
+            cat="storage",
+            plugin="fs",
+            path=read_io.path,
+        ) as sp:
+            await self._read_inner(read_io)
+            nbytes = read_io.buf.getbuffer().nbytes
+            sp.set_attrs(nbytes=nbytes)
+        telemetry.counter_add("storage.fs.read_bytes", nbytes)
+
+    async def _read_inner(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
         if read_io.byte_range is not None:
             offset, end = read_io.byte_range
@@ -161,16 +209,32 @@ class FSStoragePlugin(StoragePlugin):
             if self._use_native(nbytes):
                 read_io.buf.write(await self._native_read(path, offset, nbytes))
                 return
-            async with aiofiles.open(path, "rb") as f:
-                await f.seek(offset)
-                read_io.buf.write(await f.read(nbytes))
+            read_io.buf.write(await self._buffered_read(path, offset, nbytes))
         elif self._native is not None:
             # Full-object read: the size probe (needed to route + allocate)
             # runs inside the executor task — never stat() on the event loop.
             read_io.buf.write(await self._native_read(path, 0, None))
         else:
+            read_io.buf.write(await self._buffered_read(path, 0, None))
+
+    async def _buffered_read(
+        self, path: str, offset: int, nbytes: Optional[int]
+    ) -> bytes:
+        if aiofiles is not None:
             async with aiofiles.open(path, "rb") as f:
-                read_io.buf.write(await f.read())
+                if offset:
+                    await f.seek(offset)
+                return await (f.read(nbytes) if nbytes is not None else f.read())
+
+        def work() -> bytes:
+            with open(path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read(nbytes) if nbytes is not None else f.read()
+
+        return await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), work
+        )
 
     async def _native_read(
         self, path: str, offset: int, nbytes: Optional[int]

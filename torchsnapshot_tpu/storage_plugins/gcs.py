@@ -20,6 +20,7 @@ import asyncio
 import logging
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..memoryview_stream import MemoryviewStream
 from ..utils import knobs
@@ -72,17 +73,25 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def write(self, write_io: WriteIO) -> None:
         mv = memoryview(write_io.buf)
-        if mv.nbytes > knobs.get_gcs_chunk_bytes():
-            await self._upload_resumable(write_io.path, mv)
-            return
-        blob = self._bucket.blob(self._blob_path(write_io.path))
+        with telemetry.span(
+            "storage.write",
+            cat="storage",
+            plugin="gcs",
+            path=write_io.path,
+            nbytes=mv.nbytes,
+        ):
+            if mv.nbytes > knobs.get_gcs_chunk_bytes():
+                await self._upload_resumable(write_io.path, mv)
+            else:
+                blob = self._bucket.blob(self._blob_path(write_io.path))
 
-        def upload() -> None:
-            blob.upload_from_file(
-                MemoryviewStream(mv), size=mv.nbytes, rewind=True
-            )
+                def upload() -> None:
+                    blob.upload_from_file(
+                        MemoryviewStream(mv), size=mv.nbytes, rewind=True
+                    )
 
-        await self._retrying(upload)
+                await self._retrying(upload)
+        telemetry.counter_add("storage.gcs.write_bytes", mv.nbytes)
 
     async def _upload_resumable(self, path: str, mv: memoryview) -> None:
         """Chunked resumable upload with write-cursor recovery (reference
@@ -181,20 +190,25 @@ class GCSStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         blob = self._bucket.blob(self._blob_path(read_io.path))
-        try:
-            if read_io.byte_range is None:
-                data = await self._retrying(blob.download_as_bytes)
-            else:
-                begin, end = read_io.byte_range
-                data = await self._retrying(
-                    # GCS ranges are inclusive on both ends.
-                    lambda: blob.download_as_bytes(start=begin, end=end - 1)
-                )
-        except Exception as e:
-            if _is_not_found(e):
-                raise FileNotFoundError(read_io.path) from e
-            raise
-        read_io.buf.write(data)
+        with telemetry.span(
+            "storage.read", cat="storage", plugin="gcs", path=read_io.path
+        ) as sp:
+            try:
+                if read_io.byte_range is None:
+                    data = await self._retrying(blob.download_as_bytes)
+                else:
+                    begin, end = read_io.byte_range
+                    data = await self._retrying(
+                        # GCS ranges are inclusive on both ends.
+                        lambda: blob.download_as_bytes(start=begin, end=end - 1)
+                    )
+            except Exception as e:
+                if _is_not_found(e):
+                    raise FileNotFoundError(read_io.path) from e
+                raise
+            sp.set_attrs(nbytes=len(data))
+            read_io.buf.write(data)
+        telemetry.counter_add("storage.gcs.read_bytes", len(data))
 
     async def delete(self, path: str) -> None:
         blob = self._bucket.blob(self._blob_path(path))
@@ -214,6 +228,19 @@ class GCSStoragePlugin(StoragePlugin):
         if not src_abs_path.startswith("gs://"):
             return False
         src_bucket_name, _, src_key = src_abs_path[len("gs://") :].partition("/")
+        with telemetry.span(
+            "storage.link_in", cat="storage", plugin="gcs", path=path
+        ) as sp:
+            ok = await self._link_in_inner(src_bucket_name, src_key, path)
+            sp.set_attrs(linked=ok)
+        if ok:
+            telemetry.counter_add("storage.gcs.link_in_count")
+        return ok
+
+    async def _link_in_inner(
+        self, src_bucket_name: str, src_key: str, path: str
+    ) -> bool:
+        src_abs_path = f"gs://{src_bucket_name}/{src_key}"
         try:
             src_bucket = self._client.bucket(src_bucket_name)
             src_blob = src_bucket.blob(src_key)

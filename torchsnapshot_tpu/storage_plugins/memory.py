@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
 
@@ -23,17 +24,31 @@ class MemoryStoragePlugin(StoragePlugin):
         self.objects: Dict[str, bytes] = {}
 
     async def write(self, write_io: WriteIO) -> None:
-        self.objects[write_io.path] = bytes(write_io.buf)
+        data = bytes(write_io.buf)
+        with telemetry.span(
+            "storage.write",
+            cat="storage",
+            plugin="memory",
+            path=write_io.path,
+            nbytes=len(data),
+        ):
+            self.objects[write_io.path] = data
+        telemetry.counter_add("storage.memory.write_bytes", len(data))
 
     async def read(self, read_io: ReadIO) -> None:
-        try:
-            data = self.objects[read_io.path]
-        except KeyError:
-            raise FileNotFoundError(read_io.path) from None
-        if read_io.byte_range is not None:
-            begin, end = read_io.byte_range
-            data = data[begin:end]
-        read_io.buf.write(data)
+        with telemetry.span(
+            "storage.read", cat="storage", plugin="memory", path=read_io.path
+        ) as sp:
+            try:
+                data = self.objects[read_io.path]
+            except KeyError:
+                raise FileNotFoundError(read_io.path) from None
+            if read_io.byte_range is not None:
+                begin, end = read_io.byte_range
+                data = data[begin:end]
+            sp.set_attrs(nbytes=len(data))
+            read_io.buf.write(data)
+        telemetry.counter_add("storage.memory.read_bytes", len(data))
 
     async def delete(self, path: str) -> None:
         try:

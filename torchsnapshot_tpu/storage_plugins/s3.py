@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 from ..utils import knobs
 from .cloud_retry import CollectiveProgress, retry_transient
@@ -63,22 +64,31 @@ class S3StoragePlugin(StoragePlugin):
 
     async def write(self, write_io: WriteIO) -> None:
         mv = memoryview(write_io.buf)
-        if mv.nbytes > knobs.get_s3_chunk_bytes():
-            await self._upload_multipart(write_io.path, mv)
-            return
-        client = await self._get_client()
+        with telemetry.span(
+            "storage.write",
+            cat="storage",
+            plugin="s3",
+            path=write_io.path,
+            nbytes=mv.nbytes,
+        ):
+            if mv.nbytes > knobs.get_s3_chunk_bytes():
+                await self._upload_multipart(write_io.path, mv)
+            else:
+                client = await self._get_client()
 
-        def put():
-            return client.put_object(
-                Bucket=self.bucket,
-                Key=self._key(write_io.path),
-                # bytes-like staged buffers (incl. memoryviews) stream
-                # without a copy; copying a multi-GB shard here would blow
-                # the scheduler's memory budget accounting.
-                Body=write_io.buf,
-            )
+                def put():
+                    return client.put_object(
+                        Bucket=self.bucket,
+                        Key=self._key(write_io.path),
+                        # bytes-like staged buffers (incl. memoryviews)
+                        # stream without a copy; copying a multi-GB shard
+                        # here would blow the scheduler's memory budget
+                        # accounting.
+                        Body=write_io.buf,
+                    )
 
-        await self._retrying(put)
+                await self._retrying(put)
+        telemetry.counter_add("storage.s3.write_bytes", mv.nbytes)
 
     async def _upload_multipart(self, path: str, mv: memoryview) -> None:
         """Chunked upload with per-part retry: a transient fault re-sends at
@@ -223,13 +233,18 @@ class S3StoragePlugin(StoragePlugin):
             async with resp["Body"] as stream:
                 return await stream.read()
 
-        try:
-            data = await self._retrying(fetch)
-        except Exception as e:
-            if _is_no_such_key(e):
-                raise FileNotFoundError(read_io.path) from e
-            raise
-        read_io.buf.write(data)
+        with telemetry.span(
+            "storage.read", cat="storage", plugin="s3", path=read_io.path
+        ) as sp:
+            try:
+                data = await self._retrying(fetch)
+            except Exception as e:
+                if _is_no_such_key(e):
+                    raise FileNotFoundError(read_io.path) from e
+                raise
+            sp.set_attrs(nbytes=len(data))
+            read_io.buf.write(data)
+        telemetry.counter_add("storage.s3.read_bytes", len(data))
 
     async def delete(self, path: str) -> None:
         # S3 DeleteObject is idempotent (204 for absent keys) — the allowed
@@ -248,6 +263,18 @@ class S3StoragePlugin(StoragePlugin):
         if not src_abs_path.startswith("s3://"):
             return False
         src_bucket, _, src_key = src_abs_path[len("s3://") :].partition("/")
+        with telemetry.span(
+            "storage.link_in", cat="storage", plugin="s3", path=path
+        ) as sp:
+            ok = await self._link_in_inner(src_abs_path, src_bucket, src_key, path)
+            sp.set_attrs(linked=ok)
+        if ok:
+            telemetry.counter_add("storage.s3.link_in_count")
+        return ok
+
+    async def _link_in_inner(
+        self, src_abs_path: str, src_bucket: str, src_key: str, path: str
+    ) -> bool:
         try:
             client = await self._get_client()
             src = {"Bucket": src_bucket, "Key": src_key}

@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -69,7 +69,7 @@ _WARNED_KEYSET_SIGS: "set" = set()
 
 # Bump when the fingerprint payload or cached-plan layout changes: stale
 # in-process caches from an older scheme must never satisfy a new build.
-_FINGERPRINT_VERSION = 1
+_FINGERPRINT_VERSION = 2
 
 def _is_jax_array(obj: Any) -> bool:
     import jax
@@ -132,7 +132,11 @@ def compute_fingerprint(
         knobs.get_compression_level(),
         knobs.get_compression_frame_bytes(),
         knobs.is_checksums_enabled(),
-        knobs.is_dedup_digests_enabled(),
+        # The RAW env string, not the resolved boolean: ``auto`` resolves
+        # per-host (CPU count), and identical-env ranks must produce
+        # identical fingerprints or heterogeneous hosts would never agree
+        # on a plan-cache hit (ADVICE round 5).
+        knobs.get_dedup_digests_env(),
     )
     payload = (
         _FINGERPRINT_VERSION,
@@ -189,7 +193,10 @@ class TakePlan:
     fingerprint: str
     cache_hit: bool
     cached: Optional[CachedPlan]
-    phases: Dict[str, float] = field(default_factory=dict)
+    # Phase spans accumulated since planning began (telemetry.PhaseTracker);
+    # _take_impl keeps marking phases on the same tracker so the stall
+    # decomposition covers planning + impl as one sequence.
+    phase_tracker: Any = None
 
 
 def get_plan_cache(coord: Coordinator) -> "Dict[str, CachedPlan]":

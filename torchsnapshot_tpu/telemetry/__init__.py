@@ -1,0 +1,102 @@
+"""Unified snapshot telemetry: span tracing, metrics, Perfetto export.
+
+Every layer of the take/restore pipeline reports into this subsystem — phase
+spans in ``snapshot.py``, per-task stage/io spans in ``scheduler.py``, D2H
+spans in the io_preparers, per-request spans in the storage plugins, plan
+metrics in the batcher/partitioner, retry counters in ``cloud_retry`` — so
+"where did the time go" is answered by ONE trace instead of a pile of ad-hoc
+dicts. The legacy views (``snapshot.LAST_TAKE_PHASES``, drain stats) are
+derived from the same recorded intervals.
+
+Enabling it (pick one):
+
+- ``TORCHSNAPSHOT_TPU_TRACE=/path/trace.json`` — every take/restore records
+  a session and writes a Chrome/Perfetto trace there (non-zero ranks append
+  ``.rank<N>``). Open it at https://ui.perfetto.dev.
+- ``Snapshot.take(path, app_state, _telemetry=telemetry.Telemetry())`` —
+  programmatic capture; inspect ``tm.spans()`` / ``tm.metrics.as_dict()``
+  or ``Snapshot.last_telemetry`` afterwards.
+- ``python -m torchsnapshot_tpu trace <snapshot>`` — traced read of an
+  existing snapshot, trace written to ``--output``.
+
+When nothing is active, :func:`span` returns a shared no-op singleton and
+the metric helpers return after one ``is None`` check — the instrumented
+hot paths allocate nothing.
+
+See ``docs/observability.md`` for the span/metric catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from .core import (
+    NOOP_SPAN,
+    PhaseTracker,
+    Span,
+    Telemetry,
+    TraceBuffer,
+    activate,
+    deactivate,
+    get_active,
+    span,
+)
+from .export import (
+    metrics_from_chrome_trace,
+    spans_from_chrome_trace,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+__all__ = [
+    "Telemetry",
+    "Span",
+    "TraceBuffer",
+    "PhaseTracker",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "NOOP_SPAN",
+    "span",
+    "activate",
+    "deactivate",
+    "get_active",
+    "counter_add",
+    "gauge_set",
+    "gauge_max",
+    "histogram_observe",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "spans_from_chrome_trace",
+    "metrics_from_chrome_trace",
+]
+
+
+# Cheap metric helpers: one None-check when telemetry is off. Instrumented
+# call sites use these instead of reaching for the registry so the disabled
+# path never allocates.
+
+def counter_add(name: str, n: Union[int, float] = 1) -> None:
+    tm = get_active()
+    if tm is not None:
+        tm.metrics.counter(name).add(n)
+
+
+def gauge_set(name: str, v: Union[int, float]) -> None:
+    tm = get_active()
+    if tm is not None:
+        tm.metrics.gauge(name).set(v)
+
+
+def gauge_max(name: str, v: Union[int, float]) -> None:
+    tm = get_active()
+    if tm is not None:
+        tm.metrics.gauge(name).set_max(v)
+
+
+def histogram_observe(name: str, v: Union[int, float]) -> None:
+    tm = get_active()
+    if tm is not None:
+        tm.metrics.histogram(name).observe(v)

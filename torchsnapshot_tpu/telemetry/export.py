@@ -1,0 +1,109 @@
+"""Exporters: Chrome/Perfetto trace-event JSON and the flat metrics dict.
+
+The trace format is the Chrome trace-event JSON object form —
+``{"traceEvents": [...], "otherData": {...}}`` with complete ("ph": "X")
+events — which https://ui.perfetto.dev and chrome://tracing both open
+directly. Timestamps/durations are microseconds rebased to the session's
+``t0`` so traces start near zero.
+
+``spans_from_chrome_trace`` is the inverse used by tests (schema round-trip)
+and the CLI's summary printer; it intentionally tolerates foreign events
+(no ``args.span_id``) by synthesizing ids, so externally produced Chrome
+traces still parse.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from .core import Span, Telemetry
+
+TRACE_FORMAT_VERSION = 1
+
+
+def to_chrome_trace(tm: Telemetry) -> Dict[str, Any]:
+    events: List[Dict[str, Any]] = []
+    spans = tm.buffer.snapshot()
+    # Thread-name metadata events make Perfetto's track labels readable.
+    for tid in sorted({s.tid for s in spans}):
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": tm.pid,
+                "tid": tid,
+                "args": {"name": f"thread-{tid}"},
+            }
+        )
+    for s in spans:
+        args = dict(s.attrs)
+        args["span_id"] = s.span_id
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        events.append(
+            {
+                "name": s.name,
+                "cat": s.cat or "default",
+                "ph": "X",
+                "ts": max(0.0, (s.ts - tm.t0) * 1e6),
+                "dur": (s.dur or 0.0) * 1e6,
+                "pid": tm.pid,
+                "tid": s.tid,
+                "args": args,
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "format_version": TRACE_FORMAT_VERSION,
+            "producer": "torchsnapshot_tpu.telemetry",
+            "rank": tm.rank,
+            "dropped_spans": tm.buffer.dropped,
+            "metrics": tm.metrics.as_dict(),
+        },
+    }
+
+
+def write_chrome_trace(tm: Telemetry, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(tm), f)
+
+
+def spans_from_chrome_trace(trace: Dict[str, Any]) -> List[Span]:
+    """Rebuild Span records from an exported (or foreign) Chrome trace.
+
+    Only complete ("X") events become spans; metadata events are skipped.
+    ``ts``/``dur`` come back in seconds (matching live Span records), so a
+    round-trip preserves names, cats, durations, attrs, and parent links.
+    """
+    out: List[Span] = []
+    synthetic_id = -1
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args") or {})
+        span_id = args.pop("span_id", None)
+        parent_id = args.pop("parent_id", None)
+        if span_id is None:
+            span_id = synthetic_id
+            synthetic_id -= 1
+        sp = Span(
+            name=ev.get("name", ""),
+            cat="" if ev.get("cat") in (None, "default") else ev["cat"],
+            ts=float(ev.get("ts", 0.0)) / 1e6,
+            span_id=int(span_id),
+            parent_id=None if parent_id is None else int(parent_id),
+            attrs=args,
+        )
+        sp.dur = float(ev.get("dur", 0.0)) / 1e6
+        tid = ev.get("tid")
+        if isinstance(tid, int):
+            sp.tid = tid
+        out.append(sp)
+    return out
+
+
+def metrics_from_chrome_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
+    return dict((trace.get("otherData") or {}).get("metrics") or {})

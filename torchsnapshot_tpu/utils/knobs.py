@@ -316,7 +316,34 @@ def override_checksums(enabled: bool):
     return _override_env(_ENV_CHECKSUMS, "1" if enabled else "0")
 
 
+_ENV_TRACE = "TORCHSNAPSHOT_TPU_TRACE"
+
+
+def get_trace_path() -> Optional[str]:
+    """Destination for Chrome/Perfetto trace-event JSON. When set, every
+    ``Snapshot.take``/``async_take``/``restore`` records a telemetry session
+    (phase, scheduler stage/io, D2H, and storage-plugin spans plus the
+    metrics registry) and writes it here when the operation commits. The
+    path is per-process: rank 0 writes the path verbatim, other ranks
+    append ``.rank<N>``. Empty/unset disables tracing entirely — the
+    instrumented hot paths then cost one None-check per site."""
+    val = os.environ.get(_ENV_TRACE)
+    return val if val else None
+
+
+def override_trace_path(path: str):
+    return _override_env(_ENV_TRACE, path)
+
+
 _ENV_DEDUP_DIGESTS = "TORCHSNAPSHOT_TPU_DEDUP_DIGESTS"
+
+
+def get_dedup_digests_env() -> str:
+    """The RAW (normalized) knob string, including ``auto``. The plan-cache
+    fingerprint folds this in instead of the resolved boolean: ``auto``
+    resolves per-host (CPU count), and a host-dependent fingerprint would
+    make identical-env ranks disagree on plan-cache identity."""
+    return os.environ.get(_ENV_DEDUP_DIGESTS, "auto").lower()
 
 
 def is_dedup_digests_enabled(has_base: bool = False) -> bool:
@@ -366,7 +393,10 @@ def override_plan_cache(enabled: bool):
 _ENV_RESTORE_OVERLAP = "TORCHSNAPSHOT_TPU_RESTORE_OVERLAP"
 
 
-def is_restore_overlap_enabled(has_jax_targets: bool = False) -> bool:
+def is_restore_overlap_enabled(
+    has_jax_targets: bool = False,
+    target_platforms=None,
+) -> bool:
     """Finalize each restored entry (its host→device transfer) as its last
     read consumes — H2D overlaps the storage reads still in flight, and
     host buffers free eagerly so restore peak RSS tracks the memory budget
@@ -374,17 +404,32 @@ def is_restore_overlap_enabled(has_jax_targets: bool = False) -> bool:
 
     Default ``auto``: enabled on multi-core hosts, and — when the restore
     actually has live jax device targets (``has_jax_targets``) — on any
-    host whose default jax backend is a real accelerator: there the
+    host whose TARGET arrays live on a real accelerator: there the
     ``device_put`` dispatch hands off to the PJRT client (transfer-engine/
     network bound) and overlap measured a ~1.5x restore win with lower
     peak RSS even on a single vCPU (``benchmarks/restore_overlap/``).
-    Disabled for the CPU *backend* on a single-vCPU host: CPU-backend
-    dispatch executes the copy on the host's only core and starves behind
-    the busy read pipeline (measured 2.5-10x slower restores on the
-    reshard workload). The backend is only consulted when
-    ``has_jax_targets`` is True — live device targets imply jax is already
-    initialized, so a numpy-only restore never triggers PJRT backend
-    initialization from a knob read. ``1``/``0`` force it either way."""
+    Disabled when the targets are CPU-backed on a single-vCPU host:
+    CPU-backend dispatch executes the copy on the host's only core and
+    starves behind the busy read pipeline (measured 2.5-10x slower restores
+    on the reshard workload).
+
+    ``target_platforms``: the platforms of the restore targets' shard
+    devices — a set of strings (``{"tpu"}``), or a zero-arg callable
+    returning one (evaluated only on the single-core + jax-targets branch,
+    so multi-core hosts never pay the device walk). Deriving the gate from
+    the TARGETS rather than ``jax.default_backend()`` matters on hosts
+    where they disagree (e.g. a CPU-default process restoring onto an
+    explicitly-addressed accelerator). Mixed-backend caveat: targets
+    spanning CPU *and* accelerator devices disable overlap — the CPU-bound
+    finalizers would still starve the single core, and per-entry gating is
+    not worth the complexity (restores are per-stateful, so splitting
+    device/host state across statefuls regains overlap for the device
+    part). ``None`` falls back to ``jax.default_backend()``.
+
+    The platforms/backend are only consulted when ``has_jax_targets`` is
+    True — live device targets imply jax is already initialized, so a
+    numpy-only restore never triggers PJRT backend initialization from a
+    knob read. ``1``/``0`` force it either way."""
     val = os.environ.get(_ENV_RESTORE_OVERLAP, "auto").lower()
     if val in ("auto", ""):
         if _usable_cpu_count() > 1:
@@ -392,6 +437,10 @@ def is_restore_overlap_enabled(has_jax_targets: bool = False) -> bool:
         if not has_jax_targets:
             return False
         try:
+            if callable(target_platforms):
+                target_platforms = target_platforms()
+            if target_platforms:
+                return all(p != "cpu" for p in target_platforms)
             import jax
 
             return jax.default_backend() != "cpu"
