@@ -1,0 +1,37 @@
+"""Checkpoint-invariant static analyzer (the ``dev/lint.py`` analysis gate).
+
+Five AST passes over the library, zero third-party dependencies:
+
+1. async-safety (TSA1xx) — no blocking calls on the event loop;
+2. task-leak (TSA2xx) — every spawned task retained and reaped;
+3. knob-drift (TSA3xx) — env knobs live in ``utils/knobs.py`` and the docs
+   catalog, bidirectionally;
+4. telemetry-discipline (TSA4xx) — spans context-managed, names cataloged;
+5. manifest-schema (TSA5xx) — Entry fields stay JSON-serializable.
+
+Run: ``python -m dev.analyze`` (or via ``python dev/lint.py``).
+See ``docs/static-analysis.md`` for codes, suppression, and the baseline
+workflow.
+"""
+
+from .core import (
+    AnalysisContext,
+    Finding,
+    apply_baseline,
+    default_context,
+    get_passes,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "Finding",
+    "apply_baseline",
+    "default_context",
+    "get_passes",
+    "load_baseline",
+    "run_passes",
+    "write_baseline",
+]

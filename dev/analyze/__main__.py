@@ -1,0 +1,90 @@
+"""CLI for the checkpoint-invariant static analyzer.
+
+    python -m dev.analyze                    # analyze the repo, apply baseline
+    python -m dev.analyze --update-baseline  # grandfather current findings
+    python -m dev.analyze FILES...           # AST passes on specific files
+                                             # (doc-drift passes still run
+                                             # against the repo catalogs)
+
+Exit 0 when nothing new is found AND no baseline entry is stale; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import (
+    apply_baseline,
+    default_context,
+    get_passes,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+
+DEFAULT_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dev.analyze", description=__doc__.split("\n")[0]
+    )
+    parser.add_argument("files", nargs="*", help="restrict AST passes to these files")
+    parser.add_argument("--root", default=DEFAULT_ROOT)
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for name, _ in get_passes():
+            print(name)
+        return 0
+
+    ctx = default_context(args.root)
+    if args.files:
+        ctx.lib_files = sorted(
+            os.path.relpath(os.path.abspath(f), args.root) for f in args.files
+        )
+    findings = run_passes(ctx)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"baseline updated: {len(findings)} grandfathered finding(s) -> "
+            f"{os.path.relpath(args.baseline, args.root)}"
+        )
+        return 0
+
+    fresh, stale = apply_baseline(findings, load_baseline(args.baseline))
+    for f in fresh:
+        print(f.render())
+    for entry in stale:
+        print(f"stale baseline entry (fixed? remove it): {entry}")
+    if fresh or stale:
+        print(
+            f"\n{len(fresh)} analyzer finding(s), {len(stale)} stale "
+            "baseline entr(ies) — see docs/static-analysis.md"
+        )
+        return 1
+    n_base = len(load_baseline(args.baseline))
+    suffix = f" ({n_base} grandfathered)" if n_base else ""
+    print(f"analyzer clean: {len(ctx.lib_files)} files, 5 passes{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

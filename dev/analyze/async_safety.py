@@ -1,0 +1,194 @@
+"""Pass 1 — async-safety (TSA101-TSA103).
+
+The whole D2H/serialize/storage-I/O overlap story (``scheduler.py``) runs on
+one event loop; a single blocking call inside any ``async def`` serializes
+every in-flight pipeline behind it, silently. This pass flags blocking
+calls reachable *directly* in an async function body. The compliant idioms
+stay quiet by construction:
+
+- work routed through ``run_in_executor``/``asyncio.to_thread`` passes the
+  callable by reference — no blocking *call node* appears in async code;
+- nested sync ``def`` bodies (executor thunks like fs.py's ``work()``) are
+  not part of the async body and are skipped.
+
+Codes:
+
+- **TSA101** — known-blocking call (``time.sleep``, builtin ``open``,
+  ``os.*`` file ops, ``requests.*``, ``subprocess.*``, ``shutil.*``,
+  socket/urllib) directly inside an ``async def``.
+- **TSA102** — ``.result()`` on a ``concurrent.futures`` future obtained
+  from ``*.submit(...)`` inside an ``async def`` (blocks the loop; await a
+  wrapped future or use ``run_in_executor``). ``asyncio.Task.result()`` on
+  a completed task is fine and not flagged.
+- **TSA103** — event-loop re-entry (``*.run_until_complete`` /
+  ``*.run_forever``) inside an ``async def``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .core import AnalysisContext, Finding, dotted_name
+
+# Exact dotted names (or bare builtins) that block the calling thread.
+_BLOCKING_EXACT: Set[str] = {
+    "open",
+    "input",
+    "time.sleep",
+    "os.open",
+    "os.read",
+    "os.write",
+    "os.fsync",
+    "os.sendfile",
+    "os.remove",
+    "os.unlink",
+    "os.replace",
+    "os.rename",
+    "os.link",
+    "os.symlink",
+    "os.makedirs",
+    "os.mkdir",
+    "os.rmdir",
+    "os.listdir",
+    "os.scandir",
+    "os.stat",
+    "os.lstat",
+    "os.truncate",
+    "os.system",
+    "io.open",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "shutil.copy",
+    "shutil.copy2",
+    "shutil.copyfile",
+    "shutil.copytree",
+    "shutil.move",
+    "shutil.rmtree",
+}
+
+# Any call into these modules blocks (sync HTTP clients).
+_BLOCKING_PREFIXES = ("requests.",)
+
+_LOOP_REENTRY_ATTRS = {"run_until_complete", "run_forever"}
+
+
+def _direct_body(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
+    """Every node of ``fn``'s body that executes on the event loop: stop at
+    nested function/lambda boundaries (sync nested defs are executor thunks;
+    nested async defs are visited as their own async functions)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _blocking_reason(name: Optional[str]) -> Optional[str]:
+    if name is None:
+        return None
+    if name in _BLOCKING_EXACT:
+        return name
+    for prefix in _BLOCKING_PREFIXES:
+        if name.startswith(prefix):
+            return name
+    return None
+
+
+def _check_async_fn(
+    relpath: str, fn: ast.AsyncFunctionDef, findings: List[Finding]
+) -> None:
+    # Names bound from ``<pool>.submit(...)`` in THIS async body: calling
+    # .result() on them synchronously waits out the worker thread.
+    executor_futures: Set[str] = set()
+    body = list(_direct_body(fn))
+    for node in body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee is not None and callee.endswith(".submit"):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        executor_futures.add(tgt.id)
+
+    for node in body:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        reason = _blocking_reason(name)
+        if reason is not None:
+            findings.append(
+                Finding(
+                    path=relpath,
+                    line=node.lineno,
+                    code="TSA101",
+                    message=(
+                        f"blocking call `{reason}` inside `async def "
+                        f"{fn.name}` stalls the event loop; route it "
+                        "through run_in_executor/asyncio.to_thread"
+                    ),
+                    key=f"{fn.name}:{reason}",
+                )
+            )
+            continue
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = node.func.value
+            if attr == "result" and not node.args:
+                recv_is_submit_chain = (
+                    isinstance(recv, ast.Call)
+                    and (dotted_name(recv.func) or "").endswith(".submit")
+                )
+                recv_is_tracked = (
+                    isinstance(recv, ast.Name) and recv.id in executor_futures
+                )
+                if recv_is_submit_chain or recv_is_tracked:
+                    findings.append(
+                        Finding(
+                            path=relpath,
+                            line=node.lineno,
+                            code="TSA102",
+                            message=(
+                                "blocking Future.result() on an executor "
+                                f"future inside `async def {fn.name}`; "
+                                "await asyncio.wrap_future(...) or use "
+                                "run_in_executor"
+                            ),
+                            key=f"{fn.name}:result",
+                        )
+                    )
+            elif attr in _LOOP_REENTRY_ATTRS:
+                findings.append(
+                    Finding(
+                        path=relpath,
+                        line=node.lineno,
+                        code="TSA103",
+                        message=(
+                            f"event-loop re-entry `{attr}` inside `async "
+                            f"def {fn.name}`; await the coroutine instead"
+                        ),
+                        key=f"{fn.name}:{attr}",
+                    )
+                )
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in ctx.lib_files:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                _check_async_fn(relpath, node, findings)
+    return findings

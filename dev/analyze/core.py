@@ -1,0 +1,263 @@
+"""Framework for the checkpoint-invariant static analyzer.
+
+The paper's performance story rests on conventions no interpreter enforces:
+the asyncio pipelines must never block the event loop, every spawned task
+must be reaped, every ``TORCHSNAPSHOT_TPU_*`` knob must route through
+``utils/knobs.py`` and appear in the docs catalog, and every span/metric
+must be in the observability catalog. This package makes each convention a
+CI gate (run from ``dev/lint.py``), zero third-party dependencies.
+
+Pass modules register in :data:`PASSES`; each exposes ``run(ctx)`` yielding
+:class:`Finding`. Suppression:
+
+- inline: ``# noqa: TSA101`` on the flagged line (bare ``# noqa`` works too);
+- grandfathered: an entry in the checked-in baseline file
+  (``dev/analyze/baseline.json``). Baseline entries are ``path:CODE:key``
+  strings — no line numbers, so unrelated edits don't invalidate them.
+  Stale entries (matching no current finding) are themselves errors, so the
+  baseline can only shrink; ``--update-baseline`` rewrites it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    code: str  # TSA###
+    message: str
+    key: str  # line-independent id for baseline matching
+
+    @property
+    def baseline_id(self) -> str:
+        return f"{self.path}:{self.code}:{self.key}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9_,\s]+))?", re.IGNORECASE)
+
+
+def is_suppressed(finding: Finding, lines: List[str]) -> bool:
+    """Inline ``# noqa`` / ``# noqa: TSA101[,TSA102]`` on the flagged line."""
+    if not 1 <= finding.line <= len(lines):
+        return False
+    m = _NOQA_RE.search(lines[finding.line - 1])
+    if m is None:
+        return False
+    codes = m.group("codes")
+    if codes is None:
+        return True  # bare noqa suppresses everything
+    return finding.code in {c.strip().upper() for c in codes.split(",")}
+
+
+class AnalysisContext:
+    """Parsed view of the files one analysis run covers.
+
+    ``lib_files`` are the Python files the AST passes scan; ``knobs_path``
+    is the knob registry module; ``catalog_path`` the markdown knob catalog;
+    ``doc_files`` every doc scanned for dead knob mentions;
+    ``telemetry_catalog_path`` the markdown holding the machine-readable
+    span/metric catalog. All paths repo-relative; ``root`` is the repo root.
+    Passes read files through :meth:`source`/:meth:`tree` (parsed once,
+    cached); files that fail to parse produce one TSA000 finding and are
+    skipped by every pass (``dev/lint.py``'s syntax gate reports details).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        lib_files: List[str],
+        knobs_path: Optional[str] = None,
+        catalog_path: Optional[str] = None,
+        doc_files: Optional[List[str]] = None,
+        telemetry_catalog_path: Optional[str] = None,
+        telemetry_exempt_prefixes: Tuple[str, ...] = (),
+        manifest_path: Optional[str] = None,
+    ) -> None:
+        self.root = root
+        self.lib_files = lib_files
+        self.knobs_path = knobs_path
+        self.catalog_path = catalog_path
+        self.doc_files = doc_files or []
+        self.telemetry_catalog_path = telemetry_catalog_path
+        self.telemetry_exempt_prefixes = telemetry_exempt_prefixes
+        self.manifest_path = manifest_path
+        self._sources: Dict[str, str] = {}
+        self._trees: Dict[str, Optional[ast.AST]] = {}
+        self.parse_failures: List[Finding] = []
+
+    def source(self, relpath: str) -> str:
+        if relpath not in self._sources:
+            with open(os.path.join(self.root, relpath), encoding="utf-8") as f:
+                self._sources[relpath] = f.read()
+        return self._sources[relpath]
+
+    def lines(self, relpath: str) -> List[str]:
+        return self.source(relpath).split("\n")
+
+    def tree(self, relpath: str) -> Optional[ast.AST]:
+        if relpath not in self._trees:
+            try:
+                self._trees[relpath] = ast.parse(
+                    self.source(relpath), filename=relpath
+                )
+            except SyntaxError as e:
+                self._trees[relpath] = None
+                self.parse_failures.append(
+                    Finding(
+                        path=relpath,
+                        line=e.lineno or 0,
+                        code="TSA000",
+                        message=f"file does not parse: {e.msg}",
+                        key="syntax",
+                    )
+                )
+        return self._trees[relpath]
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child -> parent for every node; passes share this to find the
+    statement context of an expression (retained vs discarded, with-item
+    vs bare call)."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def dotted_name(func: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls on call
+    results keep their trailing attribute path: ``().result`` -> None but
+    ``x.submit().result`` -> None; only pure name chains resolve)."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_py_files(root: str, rel_dir: str) -> List[str]:
+    out = []
+    for dirpath, _, filenames in os.walk(os.path.join(root, rel_dir)):
+        for f in filenames:
+            if f.endswith(".py"):
+                out.append(
+                    os.path.relpath(os.path.join(dirpath, f), root)
+                )
+    return sorted(out)
+
+
+def default_context(root: str) -> AnalysisContext:
+    """The real repo's analysis scope: the library package, its knob
+    registry, and the two markdown catalogs."""
+    doc_files = sorted(
+        os.path.relpath(os.path.join(root, "docs", f), root)
+        for f in os.listdir(os.path.join(root, "docs"))
+        if f.endswith(".md")
+    )
+    doc_files += [f for f in ("README.md",) if os.path.exists(os.path.join(root, f))]
+    return AnalysisContext(
+        root=root,
+        lib_files=iter_py_files(root, "torchsnapshot_tpu"),
+        knobs_path="torchsnapshot_tpu/utils/knobs.py",
+        catalog_path="docs/utilities.md",
+        doc_files=doc_files,
+        telemetry_catalog_path="docs/observability.md",
+        # The telemetry subsystem implements the machinery (generic
+        # counter()/span() plumbing); the discipline passes gate its users.
+        telemetry_exempt_prefixes=("torchsnapshot_tpu/telemetry/",),
+        manifest_path="torchsnapshot_tpu/manifest.py",
+    )
+
+
+def get_passes():
+    """(name, run) for every registered pass, import deferred so the CLI
+    can list passes even if one module is mid-edit."""
+    from . import (
+        async_safety,
+        knob_drift,
+        manifest_schema,
+        task_leak,
+        telemetry_discipline,
+    )
+
+    return [
+        ("async-safety", async_safety.run),
+        ("task-leak", task_leak.run),
+        ("knob-drift", knob_drift.run),
+        ("telemetry-discipline", telemetry_discipline.run),
+        ("manifest-schema", manifest_schema.run),
+    ]
+
+
+def run_passes(ctx: AnalysisContext) -> List[Finding]:
+    """All passes over ``ctx``, inline-noqa already applied (markdown
+    findings have no noqa mechanism — use the baseline)."""
+    findings: List[Finding] = []
+    for _, run in get_passes():
+        findings.extend(run(ctx))
+    findings.extend(ctx.parse_failures)
+    out = []
+    for f in findings:
+        if f.path.endswith(".py") and is_suppressed(f, ctx.lines(f.path)):
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.code))
+
+
+def load_baseline(path: str) -> List[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {
+        "comment": (
+            "Grandfathered dev/analyze findings. Entries are "
+            "'path:CODE:key' (line-independent). Stale entries fail the "
+            "gate; regenerate with: python -m dev.analyze --update-baseline"
+        ),
+        "findings": sorted(f.baseline_id for f in findings),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: List[str]
+) -> Tuple[List[Finding], List[str]]:
+    """(new findings, stale baseline entries). Multiset semantics: one
+    baseline entry absorbs one finding, so a second identical violation in
+    the same file still fails."""
+    budget: Dict[str, int] = {}
+    for entry in baseline:
+        budget[entry] = budget.get(entry, 0) + 1
+    fresh = []
+    for f in findings:
+        if budget.get(f.baseline_id, 0) > 0:
+            budget[f.baseline_id] -= 1
+        else:
+            fresh.append(f)
+    stale = sorted(
+        entry for entry, remaining in budget.items() for _ in range(remaining)
+    )
+    return fresh, stale

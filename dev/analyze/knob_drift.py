@@ -1,0 +1,119 @@
+"""Pass 3 — knob-registry drift (TSA301-TSA303).
+
+Every ``TORCHSNAPSHOT_TPU_*`` environment knob has exactly one home:
+``utils/knobs.py`` defines it (so overrides, defaults, and local-world
+scaling live in one place) and the docs catalog (``docs/utilities.md``)
+documents it. Anything else is drift: a literal elsewhere in the library
+bypasses the registry's context-manager overrides; an undocumented knob is
+invisible to operators; a documented-but-deleted knob is a lie.
+
+Codes:
+
+- **TSA301** — ``TORCHSNAPSHOT_TPU_*`` string literal in library code
+  outside the knob registry (route the read/write through ``utils/knobs``).
+- **TSA302** — registry knob missing from the docs catalog.
+- **TSA303** — a doc mentions a ``TORCHSNAPSHOT_TPU_*`` name that no longer
+  exists in the registry (dead documentation).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from .core import AnalysisContext, Finding
+
+_KNOB_FULL_RE = re.compile(r"^TORCHSNAPSHOT_TPU_[A-Z0-9_]+$")
+_KNOB_TOKEN_RE = re.compile(r"TORCHSNAPSHOT_TPU_[A-Z0-9_]+")
+
+
+def registry_knobs(ctx: AnalysisContext) -> Dict[str, int]:
+    """{env name: first definition line} from the knob registry module."""
+    out: Dict[str, int] = {}
+    if ctx.knobs_path is None:
+        return out
+    tree = ctx.tree(ctx.knobs_path)
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _KNOB_FULL_RE.match(node.value)
+        ):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    registry = registry_knobs(ctx)
+
+    # TSA301: literals in library code outside the registry.
+    for relpath in ctx.lib_files:
+        if relpath == ctx.knobs_path:
+            continue
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KNOB_FULL_RE.match(node.value)
+            ):
+                findings.append(
+                    Finding(
+                        path=relpath,
+                        line=node.lineno,
+                        code="TSA301",
+                        message=(
+                            f"knob literal `{node.value}` outside the "
+                            "registry; add it to utils/knobs.py and call "
+                            "the getter here"
+                        ),
+                        key=node.value,
+                    )
+                )
+
+    # TSA302: registry knob absent from the docs catalog.
+    if ctx.knobs_path is not None and ctx.catalog_path is not None:
+        catalog_text = ctx.source(ctx.catalog_path)
+        catalog_names = set(_KNOB_TOKEN_RE.findall(catalog_text))
+        for env_name, lineno in sorted(registry.items()):
+            if env_name not in catalog_names:
+                findings.append(
+                    Finding(
+                        path=ctx.knobs_path,
+                        line=lineno,
+                        code="TSA302",
+                        message=(
+                            f"knob `{env_name}` is not documented in "
+                            f"{ctx.catalog_path}"
+                        ),
+                        key=env_name,
+                    )
+                )
+
+    # TSA303: documented knob that no longer exists.
+    if registry:
+        for doc in ctx.doc_files:
+            text = ctx.source(doc)
+            for i, line in enumerate(text.split("\n"), 1):
+                for token in _KNOB_TOKEN_RE.findall(line):
+                    if token not in registry:
+                        findings.append(
+                            Finding(
+                                path=doc,
+                                line=i,
+                                code="TSA303",
+                                message=(
+                                    f"documented knob `{token}` does not "
+                                    "exist in utils/knobs.py (dead catalog "
+                                    "entry?)"
+                                ),
+                                key=token,
+                            )
+                        )
+    return findings

@@ -1,0 +1,145 @@
+"""Pass 5 — manifest schema discipline (TSA501).
+
+``manifest.py``'s ``Entry`` subclasses ARE the on-storage metadata schema:
+every field must serialize to the committed JSON document and round-trip
+through ``Entry.from_dict``. A field annotated with a non-serializable type
+(an ndarray, a callable, an arbitrary object) either crashes the commit or
+— worse — pickles its repr and corrupts restores on the other side. This
+pass checks that every annotated field of every Entry subclass is built
+from serializable atoms: primitives, typing containers, and other schema
+classes defined in the same module.
+
+Code: **TSA501** — Entry-subclass field annotation uses a type outside the
+serializable grammar.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import AnalysisContext, Finding
+
+_ALLOWED_NAMES = {
+    "str",
+    "int",
+    "float",
+    "bool",
+    "bytes",
+    "None",
+    "NoneType",
+    "Any",
+    "List",
+    "Dict",
+    "Tuple",
+    "Optional",
+    "Union",
+    "Sequence",
+    "Mapping",
+    "list",
+    "dict",
+    "tuple",
+}
+
+_ROOT_CLASS = "Entry"
+
+
+def _module_classes(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    return {
+        node.name: node
+        for node in tree.body
+        if isinstance(node, ast.ClassDef)
+    }
+
+
+def _entry_subclasses(classes: Dict[str, ast.ClassDef]) -> List[ast.ClassDef]:
+    """Entry + everything transitively inheriting it (within the module)."""
+    members: Set[str] = set()
+    if _ROOT_CLASS in classes:
+        members.add(_ROOT_CLASS)
+    changed = True
+    while changed:
+        changed = False
+        for name, node in classes.items():
+            if name in members:
+                continue
+            for base in node.bases:
+                if isinstance(base, ast.Name) and base.id in members:
+                    members.add(name)
+                    changed = True
+    return [classes[n] for n in sorted(members)]
+
+
+def _bad_atom(node: ast.AST, allowed: Set[str]) -> Optional[str]:
+    """First disallowed type atom in an annotation expression, or None."""
+    if isinstance(node, ast.Name):
+        return None if node.id in allowed else node.id
+    if isinstance(node, ast.Attribute):
+        # typing.List / np.ndarray: judge by the final attribute.
+        return None if node.attr in allowed else ast.unparse(node)
+    if isinstance(node, ast.Constant):
+        if node.value is None or isinstance(node.value, type(Ellipsis)):
+            return None
+        if isinstance(node.value, str):
+            # Forward reference: parse and recurse.
+            try:
+                inner = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return node.value
+            return _bad_atom(inner, allowed)
+        return repr(node.value)
+    if isinstance(node, ast.Subscript):
+        bad = _bad_atom(node.value, allowed)
+        if bad is not None:
+            return bad
+        return _bad_atom(node.slice, allowed)
+    if isinstance(node, ast.Tuple):
+        for elt in node.elts:
+            bad = _bad_atom(elt, allowed)
+            if bad is not None:
+                return bad
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _bad_atom(node.left, allowed) or _bad_atom(node.right, allowed)
+    if isinstance(node, ast.Index):  # pragma: no cover - py<3.9 AST
+        return _bad_atom(node.value, allowed)
+    return ast.unparse(node) if hasattr(ast, "unparse") else "<complex>"
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    if ctx.manifest_path is None:
+        return findings
+    tree = ctx.tree(ctx.manifest_path)
+    if tree is None or not isinstance(tree, ast.Module):
+        return findings
+    classes = _module_classes(tree)
+    # Schema classes defined alongside Entry (Shard descriptors etc.) are
+    # serializable by the same contract, so they are allowed atoms.
+    allowed = _ALLOWED_NAMES | set(classes.keys())
+    for cls in _entry_subclasses(classes):
+        for stmt in cls.body:
+            if not isinstance(stmt, ast.AnnAssign):
+                continue
+            bad = _bad_atom(stmt.annotation, allowed)
+            if bad is not None:
+                field = (
+                    stmt.target.id
+                    if isinstance(stmt.target, ast.Name)
+                    else "<field>"
+                )
+                findings.append(
+                    Finding(
+                        path=ctx.manifest_path,
+                        line=stmt.lineno,
+                        code="TSA501",
+                        message=(
+                            f"`{cls.name}.{field}` annotation uses "
+                            f"non-serializable type `{bad}`; manifest "
+                            "entries must round-trip through the committed "
+                            "JSON document"
+                        ),
+                        key=f"{cls.name}.{field}",
+                    )
+                )
+    return findings

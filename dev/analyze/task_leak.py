@@ -1,0 +1,118 @@
+"""Pass 2 — task-leak (TSA201/TSA202).
+
+Every ``asyncio.ensure_future``/``create_task`` in the pipelines follows the
+scheduler's ``_reap`` pattern: the task is retained (dict key, list element,
+gathered) and its ``.result()`` is eventually read, so failures propagate.
+A discarded task object is garbage-collected mid-flight (Python cancels it)
+and its exception is silently dropped — the classic asyncio leak.
+
+Codes:
+
+- **TSA201** — task-spawn result discarded (bare expression statement).
+  Retain it and reap/await it, or chain ``.add_done_callback`` for a true
+  fire-and-forget (chaining keeps the statement from being a bare spawn, so
+  it is not flagged).
+- **TSA202** — task-spawn result assigned to a name that is never read
+  again in the enclosing scope: retained in name only, never reaped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import AnalysisContext, Finding, dotted_name, parent_map
+
+_SPAWN_NAMES = {"ensure_future", "create_task"}
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in _SPAWN_NAMES
+
+
+def _enclosing_scope(node: ast.AST, parents) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _name_is_read(scope: ast.AST, name: str, skip: ast.Assign) -> bool:
+    for node in ast.walk(scope):
+        if node is skip:
+            continue
+        if (
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+        # `del task` after gathering counts as handling too.
+        if (
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Del)
+        ):
+            return True
+    return False
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in ctx.lib_files:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        parents = parent_map(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_spawn(node)):
+                continue
+            parent = parents.get(node)
+            spawn = dotted_name(node.func)
+            if isinstance(parent, ast.Expr):
+                findings.append(
+                    Finding(
+                        path=relpath,
+                        line=node.lineno,
+                        code="TSA201",
+                        message=(
+                            f"`{spawn}(...)` result discarded: the task can "
+                            "be garbage-collected mid-flight and its "
+                            "exception is lost; retain and reap/await it "
+                            "(or chain .add_done_callback)"
+                        ),
+                        key=f"discard:{spawn}",
+                    )
+                )
+                continue
+            if isinstance(parent, ast.Assign):
+                targets = [
+                    t for t in parent.targets if isinstance(t, ast.Name)
+                ]
+                if len(targets) != len(parent.targets):
+                    continue  # tuple/attr targets: assume retained
+                scope = _enclosing_scope(node, parents)
+                if scope is None:
+                    continue
+                for tgt in targets:
+                    if not _name_is_read(scope, tgt.id, parent):
+                        findings.append(
+                            Finding(
+                                path=relpath,
+                                line=node.lineno,
+                                code="TSA202",
+                                message=(
+                                    f"task assigned to `{tgt.id}` is never "
+                                    "awaited/reaped in this scope; its "
+                                    "failure would be silently dropped"
+                                ),
+                                key=f"leak:{tgt.id}",
+                            )
+                        )
+    return findings

@@ -1,0 +1,218 @@
+"""Pass 4 — telemetry discipline (TSA401/TSA402).
+
+Spans must be opened with the context manager (``with telemetry.span(...)``)
+so they always close — an unclosed span corrupts the contextvar nesting for
+every span recorded after it on that task. And every span/metric name must
+appear in the observability catalog (``docs/observability.md``), or traces
+grow unexplained tracks and dashboards silently miss data.
+
+Codes:
+
+- **TSA401** — ``span(...)`` called outside a ``with``/``async with`` item
+  (``add_span`` is exempt: it records an already-closed interval, the
+  scheduler's documented low-overhead path).
+- **TSA402** — a literal span/metric name at an emission site that is not
+  in the machine-readable catalog block of the observability doc. Dynamic
+  (f-string) names are checked by their literal prefix; fully-dynamic names
+  are skipped.
+
+The catalog is the lines between ``analyzer: telemetry-catalog-begin`` and
+``...-end`` markers, each ``span <name>`` or ``metric <name>``; ``<seg>``
+segments are wildcards (``storage.<plugin>.write_bytes`` matches
+``storage.fs.write_bytes``).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, dotted_name, parent_map
+
+_CATALOG_RE = re.compile(
+    r"analyzer:\s*telemetry-catalog-begin(?P<body>.*?)"
+    r"analyzer:\s*telemetry-catalog-end",
+    re.DOTALL,
+)
+
+# call attr/name -> (kind, index of the name argument)
+_METRIC_SINKS = {
+    "counter_add": ("metric", 0),
+    "gauge_set": ("metric", 0),
+    "gauge_max": ("metric", 0),
+    "histogram_observe": ("metric", 0),
+    "counter": ("metric", 0),
+    "gauge": ("metric", 0),
+    "histogram": ("metric", 0),
+}
+_SPAN_SINKS = {"span": ("span", 0), "add_span": ("span", 0)}
+
+
+def parse_catalog(text: str) -> List[Tuple[str, str]]:
+    """[(kind, pattern)] from the machine-readable catalog block."""
+    m = _CATALOG_RE.search(text)
+    if m is None:
+        return []
+    out = []
+    for raw in m.group("body").split("\n"):
+        line = raw.strip().strip("`")
+        if not line or line.startswith(("#", "<!--", "```")):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in ("span", "metric"):
+            out.append((parts[0], parts[1]))
+    return out
+
+
+def _glob(pattern: str) -> str:
+    return re.sub(r"<[^>]*>", "*", pattern)
+
+
+def _name_matches(
+    kind: str, name: str, catalog: List[Tuple[str, str]]
+) -> bool:
+    for k, pattern in catalog:
+        if k == kind and fnmatch.fnmatchcase(name, _glob(pattern)):
+            return True
+    return False
+
+
+def _prefix_matches(
+    kind: str, prefix: str, catalog: List[Tuple[str, str]]
+) -> bool:
+    """Lenient check for f-string names: the literal prefix must be
+    compatible with some catalog entry of the same kind."""
+    for k, pattern in catalog:
+        if k != kind:
+            continue
+        g = _glob(pattern)
+        literal = g.split("*", 1)[0]
+        if g.startswith(prefix) or prefix.startswith(literal):
+            return True
+    return False
+
+
+def _literal_prefix(node: ast.JoinedStr) -> str:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            break
+    return "".join(parts)
+
+
+def _sink_kind(call: ast.Call) -> Optional[Tuple[str, int, str]]:
+    """(kind, name-arg index, sink label) when ``call`` emits telemetry."""
+    name = dotted_name(call.func)
+    last = None
+    if name is not None:
+        last = name.rsplit(".", 1)[-1]
+    elif isinstance(call.func, ast.Attribute):
+        last = call.func.attr  # receiver is a call/subscript result
+    if last is None:
+        return None
+    if last in _SPAN_SINKS:
+        kind, idx = _SPAN_SINKS[last]
+        return kind, idx, last
+    if last in _METRIC_SINKS:
+        kind, idx = _METRIC_SINKS[last]
+        return kind, idx, last
+    return None
+
+
+def _with_context_exprs(tree: ast.AST) -> Set[ast.AST]:
+    out: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.add(item.context_expr)
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    catalog: List[Tuple[str, str]] = []
+    if ctx.telemetry_catalog_path is not None:
+        catalog = parse_catalog(ctx.source(ctx.telemetry_catalog_path))
+
+    for relpath in ctx.lib_files:
+        if relpath.startswith(ctx.telemetry_exempt_prefixes or ()):
+            continue
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        with_exprs = _with_context_exprs(tree)
+        parents = parent_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            sink = _sink_kind(node)
+            if sink is None:
+                continue
+            kind, idx, label = sink
+
+            # TSA401: span() must be a with-item (directly, or behind a
+            # contextlib.ExitStack-style enter_context call).
+            if label == "span" and node not in with_exprs:
+                parent = parents.get(node)
+                in_enter_context = (
+                    isinstance(parent, ast.Call)
+                    and (dotted_name(parent.func) or "").endswith(
+                        "enter_context"
+                    )
+                )
+                if not in_enter_context:
+                    findings.append(
+                        Finding(
+                            path=relpath,
+                            line=node.lineno,
+                            code="TSA401",
+                            message=(
+                                "span() opened outside a `with` block; an "
+                                "unclosed span corrupts nesting for the "
+                                "rest of the task"
+                            ),
+                            key="span-no-with",
+                        )
+                    )
+
+            # TSA402: the emitted name must be in the catalog.
+            if ctx.telemetry_catalog_path is None or len(node.args) <= idx:
+                continue
+            arg = node.args[idx]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if not _name_matches(kind, arg.value, catalog):
+                    findings.append(
+                        Finding(
+                            path=relpath,
+                            line=node.lineno,
+                            code="TSA402",
+                            message=(
+                                f"{kind} name `{arg.value}` is not in the "
+                                "catalog "
+                                f"({ctx.telemetry_catalog_path}); add it "
+                                "there or fix the name"
+                            ),
+                            key=f"{kind}:{arg.value}",
+                        )
+                    )
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = _literal_prefix(arg)
+                if prefix and not _prefix_matches(kind, prefix, catalog):
+                    findings.append(
+                        Finding(
+                            path=relpath,
+                            line=node.lineno,
+                            code="TSA402",
+                            message=(
+                                f"dynamic {kind} name with prefix "
+                                f"`{prefix}` matches no catalog entry "
+                                f"({ctx.telemetry_catalog_path})"
+                            ),
+                            key=f"{kind}:{prefix}*",
+                        )
+                    )
+    return findings

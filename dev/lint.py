@@ -11,10 +11,15 @@ box:
 - generated benchmark tables in README.md / benchmarks/README.md match the
   newest ``BENCH_r*.json`` artifact (delegates to
   ``benchmarks/gen_tables.py --check``), so a driver-recorded regression can
-  never stay invisible in the human-facing docs.
+  never stay invisible in the human-facing docs;
+- the checkpoint-invariant static analyzer (``dev/analyze``: async-safety,
+  task-leak, knob/telemetry drift, manifest schema — see
+  ``docs/static-analysis.md``) over the library package.
 
-    python dev/lint.py            # lint the repo
-    python dev/lint.py FILES...   # lint specific files
+    python dev/lint.py            # lint + analyze the repo
+    python dev/lint.py FILES...   # lint specific files (analyzer runs too)
+    python dev/lint.py --fix      # auto-fix trailing whitespace / missing
+                                  # final newlines, then lint
 """
 
 from __future__ import annotations
@@ -104,6 +109,40 @@ def lint_file(path: str) -> list:
     return problems
 
 
+def fix_file(path: str) -> bool:
+    """Auto-remediate the mechanical problems: trailing whitespace and a
+    missing final newline. Returns True when the file changed. Tabs in
+    indentation are NOT auto-fixed (the right width is a judgment call)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    if not source:
+        return False
+    fixed = "\n".join(line.rstrip() for line in source.split("\n"))
+    if not fixed.endswith("\n"):
+        fixed += "\n"
+    if fixed == source:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(fixed)
+    return True
+
+
+def check_analyzer(paths: list) -> int:
+    """The static-analysis gate (``python -m dev.analyze``): async-safety,
+    task-leak, knob/telemetry drift, manifest schema. Subprocess so the
+    analyzer's import path (repo root) never depends on how lint was
+    invoked."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "dev.analyze", *paths]
+    proc = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        return 1
+    return 0
+
+
 def check_generated_tables() -> int:
     """Fail when the published tables drifted from the newest BENCH artifact."""
     import subprocess
@@ -121,13 +160,35 @@ def check_generated_tables() -> int:
 
 
 def main() -> None:
+    argv = sys.argv[1:]
+    fix = "--fix" in argv
+    argv = [a for a in argv if a != "--fix"]
     failed = 0
-    explicit_files = bool(sys.argv[1:])
-    for path in iter_targets(sys.argv[1:]):
+    explicit_files = bool(argv)
+    targets = iter_targets(argv)
+    if fix:
+        n_fixed = 0
+        for path in targets:
+            if fix_file(path):
+                print(f"fixed: {os.path.relpath(path, ROOT)}")
+                n_fixed += 1
+        print(f"--fix: {n_fixed} file(s) rewritten")
+    for path in targets:
         for lineno, msg in lint_file(path):
             print(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
             failed += 1
-    if not explicit_files:
+    if explicit_files:
+        # Analyzer conventions apply to the library package; lint-on-save of
+        # a test or tool file shouldn't trip library-only gates.
+        lib_paths = [
+            p
+            for p in targets
+            if os.path.relpath(p, ROOT).startswith("torchsnapshot_tpu" + os.sep)
+        ]
+        if lib_paths:
+            failed += check_analyzer(lib_paths)
+    else:
+        failed += check_analyzer([])
         failed += check_generated_tables()
     if failed:
         print(f"\n{failed} lint problem(s)")

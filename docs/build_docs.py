@@ -29,6 +29,8 @@ DOC_SOURCES = [
     "docs/getting_started.md",
     "docs/api_reference.md",
     "docs/utilities.md",
+    "docs/observability.md",
+    "docs/static-analysis.md",
 ]
 
 _FENCE_RE = re.compile(r"```(\w*)\n(.*?)```", re.DOTALL)

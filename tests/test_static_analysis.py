@@ -1,0 +1,517 @@
+"""Fixture tests for the checkpoint-invariant static analyzer (dev/analyze).
+
+Each pass is proven both ways: it flags a seeded violation, and it stays
+quiet on the compliant idiom the library actually uses (executor-wrapped
+I/O, reaped tasks, registered knobs, with-scoped cataloged spans). A final
+smoke test runs the full analyzer over the real repo and requires zero
+non-baselined findings — the same gate ``python dev/lint.py`` runs in CI.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from dev.analyze import (  # noqa: E402
+    AnalysisContext,
+    apply_baseline,
+    default_context,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+
+
+def make_ctx(tmp_path, files, **kwargs):
+    """A miniature repo: ``files`` maps relpath -> dedented source."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    lib = sorted(r for r in files if r.endswith(".py"))
+    return AnalysisContext(root=str(tmp_path), lib_files=lib, **kwargs)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: async-safety
+# ---------------------------------------------------------------------------
+
+
+def test_async_safety_flags_blocking_calls(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+            import os
+
+            async def bad_sleep():
+                time.sleep(1)
+
+            async def bad_open():
+                with open("/tmp/x") as f:
+                    return f.read()
+
+            async def bad_rename(a, b):
+                os.replace(a, b)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA101", "TSA101", "TSA101"]
+    assert {f.key for f in found} == {
+        "bad_sleep:time.sleep",
+        "bad_open:open",
+        "bad_rename:os.replace",
+    }
+
+
+def test_async_safety_quiet_on_executor_idiom(tmp_path):
+    # The library's actual pattern: blocking work lives in a nested sync
+    # thunk passed to run_in_executor — no blocking call node in async code.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+            import os
+
+            async def good(path, executor):
+                def work():
+                    with open(path, "rb") as f:
+                        return f.read()
+
+                loop = asyncio.get_event_loop()
+                data = await loop.run_in_executor(executor, work)
+                await loop.run_in_executor(executor, os.remove, path)
+                await asyncio.sleep(0)
+                return data
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+def test_async_safety_executor_future_result(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            async def bad(executor):
+                fut = executor.submit(len, b"x")
+                return fut.result()
+
+            async def also_bad(executor):
+                return executor.submit(len, b"x").result()
+
+            async def fine(done_task):
+                # asyncio.Task.result() on a reaped task does not block.
+                return done_task.result()
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA102", "TSA102"]
+
+
+def test_async_safety_loop_reentry_and_noqa(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+
+            async def bad(loop, coro):
+                return loop.run_until_complete(coro)
+
+            async def suppressed():
+                time.sleep(0.01)  # noqa: TSA101
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA103"]
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: task-leak
+# ---------------------------------------------------------------------------
+
+
+def test_task_leak_flags_discarded_and_unreaped(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            async def discarded(coro):
+                asyncio.ensure_future(coro)
+
+            async def unreaped(coro):
+                task = asyncio.create_task(coro)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA201", "TSA202"]
+
+
+def test_task_leak_quiet_on_reaped_idioms(tmp_path):
+    # The scheduler's patterns: dict-keyed tasks reaped via .result(),
+    # gathered lists, and add_done_callback fire-and-forget.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            async def dict_reap(reqs):
+                tasks = {}
+                for r in reqs:
+                    t = asyncio.ensure_future(r.run())
+                    tasks[t] = r
+                done, _ = await asyncio.wait(set(tasks))
+                for t in done:
+                    t.result()
+
+            async def gathered(coros):
+                tasks = [asyncio.ensure_future(c) for c in coros]
+                return await asyncio.gather(*tasks)
+
+            async def fire_and_forget(coro, handler):
+                asyncio.ensure_future(coro).add_done_callback(handler)
+
+            async def awaited(coro):
+                return await asyncio.ensure_future(coro)
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: knob-registry drift
+# ---------------------------------------------------------------------------
+
+_KNOBS = """
+import os
+
+_ENV_A = "TORCHSNAPSHOT_TPU_ALPHA"
+_ENV_B = "TORCHSNAPSHOT_TPU_BETA"
+
+
+def get_alpha():
+    return os.environ.get(_ENV_A)
+
+
+def get_beta():
+    return os.environ.get(_ENV_B)
+"""
+
+
+def _knob_ctx(tmp_path, lib_src, doc_src):
+    return make_ctx(
+        tmp_path,
+        {"pkg/knobs.py": _KNOBS, "pkg/lib.py": lib_src, "docs/knobs.md": doc_src},
+        knobs_path="pkg/knobs.py",
+        catalog_path="docs/knobs.md",
+        doc_files=["docs/knobs.md"],
+    )
+
+
+def test_knob_drift_flags_literal_outside_registry(tmp_path):
+    ctx = _knob_ctx(
+        tmp_path,
+        """
+        import os
+
+        def bad():
+            return os.environ.get("TORCHSNAPSHOT_TPU_ALPHA")
+        """,
+        "`TORCHSNAPSHOT_TPU_ALPHA` and `TORCHSNAPSHOT_TPU_BETA`\n",
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA301"]
+    assert found[0].path == "pkg/lib.py"
+
+
+def test_knob_drift_flags_undocumented_and_dead_knobs(tmp_path):
+    ctx = _knob_ctx(
+        tmp_path,
+        "from . import knobs\n",
+        "`TORCHSNAPSHOT_TPU_ALPHA` and `TORCHSNAPSHOT_TPU_GONE`\n",
+    )
+    found = run_passes(ctx)
+    # BETA exists but is undocumented; GONE is documented but gone.
+    assert codes(found) == ["TSA302", "TSA303"]
+    by_code = {f.code: f for f in found}
+    assert by_code["TSA302"].key == "TORCHSNAPSHOT_TPU_BETA"
+    assert by_code["TSA303"].key == "TORCHSNAPSHOT_TPU_GONE"
+
+
+def test_knob_drift_quiet_when_consistent(tmp_path):
+    ctx = _knob_ctx(
+        tmp_path,
+        """
+        from . import knobs
+
+        def good():
+            return knobs.get_alpha() or knobs.get_beta()
+        """,
+        "`TORCHSNAPSHOT_TPU_ALPHA` and `TORCHSNAPSHOT_TPU_BETA`\n",
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: telemetry discipline
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_DOC = """
+<!-- analyzer: telemetry-catalog-begin -->
+    span  storage.write
+    span  scheduler.stage
+    metric  storage.<plugin>.write_bytes
+    metric  cloud_retry.<plugin>.retries
+<!-- analyzer: telemetry-catalog-end -->
+"""
+
+
+def _telemetry_ctx(tmp_path, lib_src):
+    return make_ctx(
+        tmp_path,
+        {"lib.py": lib_src, "docs/obs.md": _TELEMETRY_DOC},
+        telemetry_catalog_path="docs/obs.md",
+    )
+
+
+def test_telemetry_flags_span_outside_with(tmp_path):
+    ctx = _telemetry_ctx(
+        tmp_path,
+        """
+        from . import telemetry
+
+        def bad():
+            sp = telemetry.span("storage.write", cat="storage")
+            return sp
+        """,
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA401"]
+
+
+def test_telemetry_flags_uncataloged_names(tmp_path):
+    ctx = _telemetry_ctx(
+        tmp_path,
+        """
+        from . import telemetry
+
+        def bad(nbytes, plugin):
+            with telemetry.span("storage.mystery", cat="storage"):
+                telemetry.counter_add("storage.fs.mystery_bytes", nbytes)
+                telemetry.counter_add(f"made_up.{plugin}.retries")
+        """,
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA402", "TSA402", "TSA402"]
+
+
+def test_telemetry_quiet_on_compliant_sites(tmp_path):
+    ctx = _telemetry_ctx(
+        tmp_path,
+        """
+        from . import telemetry
+
+        def good(nbytes, label, tm, t0, dur):
+            with telemetry.span("storage.write", cat="storage"):
+                telemetry.counter_add("storage.fs.write_bytes", nbytes)
+                telemetry.counter_add(f"cloud_retry.{label}.retries")
+            # add_span records an already-closed interval: exempt from 401,
+            # name still checked.
+            tm.add_span("scheduler.stage", "scheduler", t0, dur, {})
+        """,
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 5: manifest schema
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_schema_flags_unserializable_field(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "manifest.py": """
+            from dataclasses import dataclass
+            from typing import List, Optional
+
+            import numpy as np
+
+
+            @dataclass
+            class Entry:
+                type: str
+
+
+            @dataclass
+            class GoodEntry(Entry):
+                location: str
+                shape: List[int]
+                byte_range: Optional[List[int]] = None
+
+
+            @dataclass
+            class BadEntry(Entry):
+                payload: np.ndarray
+            """
+        },
+        manifest_path="manifest.py",
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA501"]
+    assert found[0].key == "BadEntry.payload"
+
+
+def test_manifest_schema_allows_nested_schema_classes(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "manifest.py": """
+            from dataclasses import dataclass
+            from typing import Dict, List
+
+
+            @dataclass
+            class Shard:
+                offsets: List[int]
+                sizes: List[int]
+
+
+            @dataclass
+            class Entry:
+                type: str
+
+
+            @dataclass
+            class ShardedEntry(Entry):
+                shards: List[Shard]
+                extra: Dict[str, "Shard"]
+            """
+        },
+        manifest_path="manifest.py",
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_and_detects_stale(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+
+            async def grandfathered():
+                time.sleep(1)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA101"]
+
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, found)
+    baseline = load_baseline(baseline_path)
+
+    fresh, stale = apply_baseline(found, baseline)
+    assert fresh == [] and stale == []
+
+    # A second identical violation is NOT absorbed (multiset semantics).
+    fresh, stale = apply_baseline(found + found, baseline)
+    assert codes(fresh) == ["TSA101"]
+
+    # Fixing the violation makes the entry stale — the gate must fail.
+    fresh, stale = apply_baseline([], baseline)
+    assert fresh == [] and len(stale) == 1
+
+
+# ---------------------------------------------------------------------------
+# Repo gates
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_all_passes():
+    """The real library carries zero non-baselined findings — the exact
+    invariant `python dev/lint.py` enforces in CI."""
+    ctx = default_context(REPO_ROOT)
+    findings = run_passes(ctx)
+    baseline = load_baseline(
+        os.path.join(REPO_ROOT, "dev", "analyze", "baseline.json")
+    )
+    fresh, stale = apply_baseline(findings, baseline)
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_repo_telemetry_catalog_parses():
+    """The machine-readable catalog in docs/observability.md stays parseable
+    and non-trivial (a silently-empty catalog would let every name pass)."""
+    from dev.analyze.telemetry_discipline import parse_catalog
+
+    with open(
+        os.path.join(REPO_ROOT, "docs", "observability.md"), encoding="utf-8"
+    ) as f:
+        catalog = parse_catalog(f.read())
+    kinds = {k for k, _ in catalog}
+    assert kinds == {"span", "metric"}
+    assert len(catalog) > 20
+
+
+@pytest.mark.slow
+def test_analyzer_cli_smoke():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dev.analyze"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analyzer clean" in proc.stdout
+
+
+def test_lint_fix_mode(tmp_path):
+    """`dev/lint.py --fix` remediates trailing whitespace and missing final
+    newlines in place."""
+    target = tmp_path / "messy.py"
+    target.write_text("x = 1   \ny = 2")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "dev", "lint.py"),
+            "--fix",
+            str(target),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert target.read_text() == "x = 1\ny = 2\n"

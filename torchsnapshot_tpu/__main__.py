@@ -202,10 +202,10 @@ def main(argv=None) -> int:
         # Any failure (bad object path, checksum-less snapshot, missing
         # snapshot, cloud NotFound/auth errors) exits 2 with a one-line
         # message, never a traceback — exit 1 is reserved for "verify found
-        # problems". Set TORCHSNAPSHOT_TPU_CLI_TRACEBACK=1 to debug.
-        import os
+        # problems". Set the CLI-traceback knob to debug.
+        from .utils import knobs
 
-        if os.environ.get("TORCHSNAPSHOT_TPU_CLI_TRACEBACK"):
+        if knobs.is_cli_traceback_enabled():
             raise
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return 2

@@ -17,10 +17,10 @@ store needs no cleanup-synchronization between consecutive collectives.
 
 from __future__ import annotations
 
-import os
 import pickle
 from typing import Any, List, Optional
 
+from ..utils import knobs
 from .store import (
     JaxCoordinationStore,
     LocalStore,
@@ -28,16 +28,10 @@ from .store import (
     TCPStore,
 )
 
-_ENV_STORE_ADDR = "TORCHSNAPSHOT_TPU_STORE_ADDR"  # host:port of a TCPStore
-_ENV_RANK = "TORCHSNAPSHOT_TPU_RANK"
-_ENV_WORLD_SIZE = "TORCHSNAPSHOT_TPU_WORLD_SIZE"
-
 
 def _resolve_timeout(timeout_s: Optional[float]) -> float:
     """Default collective timeout, raisable via the barrier-timeout knob
     (commit barriers legitimately wait out the slowest rank's data write)."""
-    from ..utils import knobs
-
     return timeout_s if timeout_s is not None else knobs.get_barrier_timeout_s()
 
 
@@ -187,10 +181,14 @@ def get_coordinator(coordinator: Optional[Coordinator] = None) -> Coordinator:
             JaxCoordinationStore(), jax.process_index(), jax.process_count()
         )
     else:
-        addr = os.environ.get(_ENV_STORE_ADDR)
+        addr = knobs.get_store_addr()
         if addr:
-            rank = int(os.environ[_ENV_RANK])
-            world_size = int(os.environ[_ENV_WORLD_SIZE])
+            rank = knobs.get_env_rank()
+            world_size = knobs.get_env_world_size()
+            assert rank is not None and world_size is not None, (
+                "TCPStore coordination needs the rank/world-size knobs "
+                "set alongside the store address"
+            )
             host, _, port = addr.rpartition(":")
             store = TCPStore(host, int(port), is_server=(rank == 0))
             _CACHED = Coordinator(store, rank, world_size)

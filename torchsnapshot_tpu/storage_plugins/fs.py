@@ -152,10 +152,22 @@ class FSStoragePlugin(StoragePlugin):
                 await asyncio.get_event_loop().run_in_executor(
                     self._get_executor(), buffered_write
                 )
-            os.replace(tmp_path, path)
+            # Rename/cleanup are metadata ops, but on network filesystems
+            # (NFS-mounted checkpoint dirs) even those can stall for a
+            # round-trip — keep the event loop clean and do them on the
+            # plugin's pool alongside the write they finalize.
+            await asyncio.get_event_loop().run_in_executor(
+                self._get_executor(), os.replace, tmp_path, path
+            )
         except BaseException:
-            with contextlib.suppress(OSError):
-                os.remove(tmp_path)
+
+            def cleanup() -> None:
+                with contextlib.suppress(OSError):
+                    os.remove(tmp_path)
+
+            await asyncio.get_event_loop().run_in_executor(
+                self._get_executor(), cleanup
+            )
             raise
 
     async def link_in(self, src_abs_path: str, path: str) -> bool:
@@ -260,7 +272,9 @@ class FSStoragePlugin(StoragePlugin):
         )
 
     async def delete(self, path: str) -> None:
-        os.remove(os.path.join(self.root, path))
+        await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), os.remove, os.path.join(self.root, path)
+        )
 
     async def close(self) -> None:
         if self._executor is not None:

@@ -110,9 +110,9 @@ def _worker_entry(
 
         # TPU platform plugins can override JAX_PLATFORMS; force cpu.
         jax.config.update("jax_platforms", "cpu")
-        os.environ["TORCHSNAPSHOT_TPU_STORE_ADDR"] = store_addr
-        os.environ["TORCHSNAPSHOT_TPU_RANK"] = str(rank)
-        os.environ["TORCHSNAPSHOT_TPU_WORLD_SIZE"] = str(world_size)
+        from .utils import knobs
+
+        knobs.set_coordinator_env(store_addr, rank, world_size)
         if init_jax_distributed:
             import jax
 
@@ -146,9 +146,9 @@ def _worker_entry(
                     # Bounded linger; tests that kill peers outright can
                     # shrink it so the survivor doesn't idle out the full
                     # default waiting for a checkout that will never come.
-                    drain_s = float(
-                        os.environ.get("TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S", "20")
-                    )
+                    from .utils import knobs
+
+                    drain_s = knobs.get_launcher_drain_s()
                     deadline = _time.monotonic() + drain_s
                     while _time.monotonic() < deadline:
                         if store.add("__launcher_exit__", 0) >= world_size:

@@ -549,6 +549,59 @@ def override_consuming_threads(value: int):
     return _override_env(_ENV_CONSUMING_THREADS, str(value))
 
 
+# -- control-plane / operator knobs ------------------------------------------
+# Not performance thresholds, but env-var configuration all the same: the
+# TCPStore coordination mode, the multi-process launcher's shutdown linger,
+# and the CLI's debug switch. Registered here (and in the docs catalog) like
+# every other TORCHSNAPSHOT_TPU_* name — the knob-drift analyzer pass
+# enforces that no literal appears anywhere else in the library.
+
+_ENV_STORE_ADDR = "TORCHSNAPSHOT_TPU_STORE_ADDR"  # host:port of a TCPStore
+_ENV_RANK = "TORCHSNAPSHOT_TPU_RANK"
+_ENV_WORLD_SIZE = "TORCHSNAPSHOT_TPU_WORLD_SIZE"
+_ENV_LAUNCHER_DRAIN_S = "TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"
+_ENV_CLI_TRACEBACK = "TORCHSNAPSHOT_TPU_CLI_TRACEBACK"
+
+
+def get_store_addr() -> Optional[str]:
+    """TCPStore coordination endpoint (``host:port``). Set alongside rank /
+    world size to coordinate without ``jax.distributed``; unset, the
+    coordinator falls back to jax's coordination service (or runs solo)."""
+    return os.environ.get(_ENV_STORE_ADDR) or None
+
+
+def get_env_rank() -> Optional[int]:
+    val = os.environ.get(_ENV_RANK)
+    return int(val) if val is not None else None
+
+
+def get_env_world_size() -> Optional[int]:
+    val = os.environ.get(_ENV_WORLD_SIZE)
+    return int(val) if val is not None else None
+
+
+def set_coordinator_env(store_addr: str, rank: int, world_size: int) -> None:
+    """Point THIS process (and its children) at a TCPStore: the launcher-side
+    writer for the three coordination knobs above."""
+    os.environ[_ENV_STORE_ADDR] = store_addr
+    os.environ[_ENV_RANK] = str(rank)
+    os.environ[_ENV_WORLD_SIZE] = str(world_size)
+
+
+def get_launcher_drain_s() -> float:
+    """How long ``test_utils.run_with_processes``'s rank 0 lingers after its
+    own work so peers still inside a final store op aren't connection-reset
+    (rank 0 hosts the TCPStore server). Tests that kill peers outright
+    shrink it so the survivor doesn't idle out the full default."""
+    return float(os.environ.get(_ENV_LAUNCHER_DRAIN_S, "20"))
+
+
+def is_cli_traceback_enabled() -> bool:
+    """``python -m torchsnapshot_tpu`` debug switch: surface the full
+    traceback instead of the one-line scriptable error."""
+    return os.environ.get(_ENV_CLI_TRACEBACK, "") not in ("", "0", "false", "False")
+
+
 @contextlib.contextmanager
 def _override_env(name: str, value: str) -> Generator[None, None, None]:
     prev = os.environ.get(name)
