@@ -280,6 +280,113 @@ def main() -> None:
         # bottleneck).
         ref_equiv_stall_s = gb / statistics.median(naive_d2h_rates)
 
+        # ---- streaming on/off A/B: the intra-request overlap win. Same
+        # interleaved-reps protocol as the naive/sync A/B (fresh device
+        # arrays per rep, alternating order, link probes bracketing each
+        # drain) so the trajectory records drain_vs_link for BOTH paths.
+        from torchsnapshot_tpu.utils import knobs as _knobs
+
+        stream_reps = int(os.environ.get("BENCH_STREAM_AB_REPS", "2"))
+        stream_gb = float(os.environ.get("BENCH_STREAM_AB_GB", "0.5"))
+        # Two big dim-0-chunkable arrays: above the streaming threshold
+        # (2 x TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES), so the on-side drains
+        # them as chunk streams while the off-side stages whole.
+        stream_rows = max(4, int(stream_gb * 1e9 / 2 / (16384 * 2)))
+
+        def build_stream_slice(seed: int):
+            import jax.numpy as jnp
+
+            ks = jax.random.split(jax.random.PRNGKey(3000 + seed), 2)
+            s = {
+                f"b{j}": jax.random.normal(
+                    ks[j], (stream_rows, 16384), jnp.bfloat16
+                )
+                for j in range(2)
+            }
+            jax.block_until_ready(s)
+            return s
+
+        stream_sides = {"on": [], "off": []}
+
+        def run_stream_rep(rep: int, enabled: bool) -> None:
+            label = "on" if enabled else "off"
+            sub = build_stream_slice(2 * rep + (0 if enabled else 1))
+            sub_gb = sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(sub)
+            ) / 1e9
+            link0 = probe_link(100 + 10 * rep + (0 if enabled else 5))
+            with _knobs.override_stream_writes(enabled):
+                pend = Snapshot.async_take(
+                    os.path.join(root, f"ckpt_stream_{label}_{rep}"),
+                    {"model": StateDict(**sub)},
+                )
+                t0 = time.perf_counter()
+                pend.wait()
+                rep_drain_s = time.perf_counter() - t0
+            link1 = probe_link(300 + 10 * rep + (0 if enabled else 5))
+            link = statistics.median([link0, link1])
+            ds = pend.drain_stats
+            shorter = min(ds.get("stage_busy_s", 0.0), ds.get("io_busy_s", 0.0))
+            rate = sub_gb / max(rep_drain_s, 1e-9)
+            stream_sides[label].append(
+                {
+                    "drain_s": round(rep_drain_s, 2),
+                    "drain_gbps": round(rate, 4),
+                    "link_gbps": round(link, 4),
+                    "drain_vs_link": round(rate / link, 2),
+                    "overlap_s": round(ds.get("overlap_s", 0.0), 2),
+                    "overlap_frac_of_shorter": round(
+                        ds.get("overlap_s", 0.0) / shorter, 2
+                    )
+                    if shorter > 0
+                    else 1.0,
+                    "stage_busy_s": round(ds.get("stage_busy_s", 0.0), 2),
+                    "io_busy_s": round(ds.get("io_busy_s", 0.0), 2),
+                }
+            )
+            log(
+                f"stream A/B rep {rep} [{label}]: {sub_gb:.2f} GB drained in "
+                f"{rep_drain_s:.2f}s -> {stream_sides[label][-1]}"
+            )
+            shutil.rmtree(
+                os.path.join(root, f"ckpt_stream_{label}_{rep}"),
+                ignore_errors=True,
+            )
+
+        for rep in range(stream_reps):
+            # Alternate which side goes first (same drift hygiene as above).
+            order = (True, False) if rep % 2 == 0 else (False, True)
+            run_stream_rep(rep, order[0])
+            run_stream_rep(rep, order[1])
+
+        def _median_of(label: str, key: str) -> float:
+            return statistics.median(r[key] for r in stream_sides[label])
+
+        stream_ab = {
+            "reps": stream_reps,
+            "size_gb": round(stream_gb, 2),
+            "on": {
+                k: _median_of("on", k)
+                for k in (
+                    "drain_gbps",
+                    "drain_vs_link",
+                    "overlap_s",
+                    "overlap_frac_of_shorter",
+                )
+            },
+            "off": {
+                k: _median_of("off", k)
+                for k in (
+                    "drain_gbps",
+                    "drain_vs_link",
+                    "overlap_s",
+                    "overlap_frac_of_shorter",
+                )
+            },
+            "all": stream_sides,
+        }
+        log(f"stream A/B medians: on={stream_ab['on']} off={stream_ab['off']}")
+
         # ---- restore bit-exactness via random access into the async ckpt
         snap = Snapshot(os.path.join(root, "ckpt_async"))
         probe = list(params)[-1]
@@ -313,6 +420,7 @@ def main() -> None:
                         "drain_stats_s": drain_stats,
                         "sync_drain_stats_s": sync_drains,
                         "target_stall_s": 5.0,
+                        "stream_ab": stream_ab,
                         "sync_take_gbps": round(sync_gbps, 3),
                         "naive_save_gbps": round(naive_gbps, 3),
                         "speedup_vs_naive_sync": round(sync_gbps / naive_gbps, 2),

@@ -30,6 +30,7 @@ DOC_SOURCES = [
     "docs/api_reference.md",
     "docs/utilities.md",
     "docs/observability.md",
+    "docs/performance.md",
     "docs/static-analysis.md",
 ]
 

@@ -263,3 +263,31 @@ def test_read_merge_respects_budget_cap() -> None:
     # A single over-cap request still passes through whole.
     big = [ReadReq(path="obj", buffer_consumer=_Noop(), byte_range=(0, 1000))]
     assert batch_read_requests(list(big), max_merged_bytes=250)[0].byte_range == (0, 1000)
+
+
+def test_batched_take_restore_with_streamed_slabs(tmp_path) -> None:
+    """Slabs routed through the streaming write path (slab cost above the
+    stream threshold) land as single objects and restore bit-exact."""
+    rng = np.random.default_rng(2)
+    sd = StateDict(
+        **{f"p{i}": rng.standard_normal((7, 5)).astype(np.float32) for i in range(20)}
+    )
+    expected = dict(sd)
+    path = str(tmp_path / "ckpt")
+    with knobs.override_batching_enabled(True), \
+            knobs.override_slab_size_threshold_bytes(400), \
+            knobs.override_stream_writes(True), \
+            knobs.override_stream_chunk_bytes(128), \
+            knobs.override_stream_inflight(2):
+        snap = Snapshot.take(path, {"s": sd})
+        out = StateDict()
+        Snapshot(path).restore({"s": out})
+    assert_state_dict_eq(dict(out), expected, exact=True)
+    manifest = snap.get_manifest()
+    slabbed = [
+        e
+        for k, e in manifest.items()
+        if getattr(e, "location", "").startswith("batched/")
+    ]
+    assert len(slabbed) == 20
+    assert Snapshot(path).verify() == {}

@@ -625,3 +625,69 @@ def test_moto_snapshot_end_to_end(s3_emulator) -> None:
     assert np.array_equal(out["s"]["arr"], arr)
     assert out["s"]["step"] == 3
     assert snap.verify() == {}
+
+
+# ------------------------------------------------------ streamed writes
+
+
+def test_streamed_write_lands_as_one_multipart_object(fake_multipart_s3) -> None:
+    """write_stream appends buffer to the part size and upload as parts;
+    commit sends the tail part + completes — one object, atomically."""
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, _ = fake_multipart_s3
+    plugin = S3StoragePlugin(root="bucket")
+    pieces = [bytes([i]) * 700 for i in range(7)]  # 4900 B -> parts of 1 KiB
+
+    async def go():
+        stream = await plugin.write_stream("streamed")
+        for p in pieces:
+            await stream.append(p)
+        # Nothing is visible before commit.
+        assert ("bucket", "streamed") not in objects
+        await stream.commit()
+
+    with knobs.override_s3_chunk_bytes(1024):
+        _run(go())
+    _run(plugin.close())
+    assert objects[("bucket", "streamed")] == b"".join(pieces)
+    assert stats["completed"] == 1 and stats.get("aborted", 0) == 0
+
+
+def test_streamed_write_abort_leaves_no_object_no_parts(fake_multipart_s3) -> None:
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, _ = fake_multipart_s3
+    plugin = S3StoragePlugin(root="bucket")
+
+    async def go():
+        stream = await plugin.write_stream("doomed")
+        await stream.append(bytes(3000))  # crosses the part size: upload began
+        await stream.abort()
+
+    with knobs.override_s3_chunk_bytes(1024):
+        _run(go())
+    _run(plugin.close())
+    assert ("bucket", "doomed") not in objects
+    assert stats.get("aborted", 0) == 1
+
+
+def test_streamed_small_stream_degenerates_to_put(fake_multipart_s3) -> None:
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+    from torchsnapshot_tpu.utils import knobs
+
+    objects, stats, _ = fake_multipart_s3
+    plugin = S3StoragePlugin(root="bucket")
+
+    async def go():
+        stream = await plugin.write_stream("small")
+        await stream.append(b"tiny")
+        await stream.commit()
+
+    with knobs.override_s3_chunk_bytes(1024):
+        _run(go())
+    _run(plugin.close())
+    assert objects[("bucket", "small")] == b"tiny"
+    assert stats.get("puts") == 1 and "created" not in stats

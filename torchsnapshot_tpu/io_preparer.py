@@ -271,12 +271,11 @@ def _host_capture_group(group: List[Any]) -> List[HostCapturedArray]:
     """Blocking host capture of a group of arrays: async D2H hints for EVERY
     shard of EVERY array first, so the per-shard resolves pipeline on the
     transfer engine instead of serializing array by array."""
+    from .io_preparers.array import hint_copy_to_host
+
     for a in group:
         for s in a.addressable_shards:
-            try:
-                s.data.copy_to_host_async()
-            except Exception:  # pragma: no cover - platform-specific hint
-                pass
+            hint_copy_to_host(s.data)
     return [_host_capture(a) for a in group]
 
 

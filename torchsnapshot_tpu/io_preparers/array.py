@@ -20,9 +20,12 @@ reference's ``torch.save`` fallback, ``tensor.py:66-69``).
 from __future__ import annotations
 
 import asyncio
+import logging
+import math
 import pickle
+from collections import deque
 from concurrent.futures import Executor
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, AsyncIterator, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -53,6 +56,8 @@ from ..utils import knobs
 # a table).
 FRAME_TABLE_SUFFIX = ".ftab"
 
+logger = logging.getLogger(__name__)
+
 
 def _is_jax_array(obj: Any) -> bool:
     import jax
@@ -60,16 +65,61 @@ def _is_jax_array(obj: Any) -> bool:
     return isinstance(obj, jax.Array)
 
 
+# One warning per process when a platform lacks the async D2H hint — not one
+# per array per take.
+_hint_unsupported_warned = False
+
+
+def hint_copy_to_host(arr: Any) -> None:
+    """Best-effort ``copy_to_host_async`` D2H hint.
+
+    Only the narrow "this platform/array doesn't implement the hint" errors
+    are swallowed (logged once; ``np.asarray`` still works, just without the
+    overlap). A real XLA transfer failure propagates — silently retrying it
+    as a blocking ``np.asarray`` would hide the device-side error until it
+    resurfaces somewhere far less attributable."""
+    global _hint_unsupported_warned
+    try:
+        arr.copy_to_host_async()
+    except (NotImplementedError, AttributeError) as e:
+        if not _hint_unsupported_warned:
+            _hint_unsupported_warned = True
+            logger.info(
+                "copy_to_host_async unavailable on this platform (%s); "
+                "D2H transfers will not be hinted ahead of np.asarray", e
+            )
+
+
+def chunk_row_ranges(
+    shape, itemsize: int, max_chunk_bytes: int
+) -> List[Tuple[int, int]]:
+    """Row ranges [r0, r1) per dim-0 chunk, each chunk <= max_chunk_bytes
+    (when a single row fits). Shared by the chunked-array preparer (one
+    storage object per chunk) and the streaming stager (one chunk per
+    streamed append into a single object)."""
+    dim0 = int(shape[0])
+    row_bytes = itemsize * int(np.prod(shape[1:])) if len(shape) > 1 else itemsize
+    rows_per_chunk = max(1, max_chunk_bytes // max(row_bytes, 1))
+    n_chunks = math.ceil(dim0 / rows_per_chunk)
+    # Even spread so the last chunk isn't tiny.
+    base = dim0 // n_chunks
+    extra = dim0 % n_chunks
+    ranges = []
+    r0 = 0
+    for i in range(n_chunks):
+        rows = base + (1 if i < extra else 0)
+        ranges.append((r0, r0 + rows))
+        r0 += rows
+    return ranges
+
+
 def to_host(arr: Any, executor: Optional[Executor] = None):
     """Kick off an async D2H transfer; return an awaitable resolver."""
     if _is_jax_array(arr):
-        try:
-            arr.copy_to_host_async()
-        except Exception:
-            pass  # some platforms lack the async hint; np.asarray still works
+        hint_copy_to_host(arr)
 
     async def resolve() -> np.ndarray:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         if executor is not None:
             return await loop.run_in_executor(executor, np.asarray, arr)
         return np.asarray(arr)
@@ -124,6 +174,9 @@ class ArrayBufferStager(BufferStager):
         # members together at the slab level); entry.serializer still
         # records the codec for the read side.
         self.stage_raw = False
+        # First stream chunk's device slice, pre-hinted by start_d2h_hint
+        # when this request will stream (see the note there).
+        self._first_slice = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         if not self.entry.frame_bytes:
@@ -172,7 +225,7 @@ class ArrayBufferStager(BufferStager):
             # request's transfers and writes.
             view = array_as_bytes_view(host)
             level = self.compression_level
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             if self.entry.frame_bytes:
                 def framed():
                     payload, sizes = compress_framed(
@@ -207,12 +260,152 @@ class ArrayBufferStager(BufferStager):
             return 2 * nbytes
         return nbytes
 
+    # -- streaming protocol --------------------------------------------------
+
+    def _stream_row_ranges(self) -> List[Tuple[int, int]]:
+        shape = self.entry.shape
+        if not shape or int(shape[0]) < 2:
+            return []
+        itemsize = entry_np_dtype(self.entry.dtype, self.entry.serializer).itemsize
+        return chunk_row_ranges(shape, itemsize, knobs.get_stream_chunk_bytes())
+
+    def can_stream(self) -> bool:
+        if self.stage_raw:
+            # Slab members are consumed synchronously by the slab's own
+            # stager; the SLAB streams (or not), never the member.
+            return False
+        serializer = self.entry.serializer
+        if serializer == Serializer.RAW:
+            pass
+        elif is_raw_family(serializer) and self.entry.frame_bytes:
+            # Framed compression: frames are independent, so chunk-local
+            # compression concatenates to the identical payload.
+            pass
+        else:
+            # Pickle and single-blob compressed payloads need the whole
+            # buffer in one call.
+            return False
+        if self.is_async_snapshot and not _is_jax_array(self.arr):
+            # Mutable host source on an async take: capture semantics
+            # require a private buffer before async_take returns; a stream
+            # keeps reading the live array long after training resumed.
+            return False
+        return len(self._stream_row_ranges()) > 1
+
+    async def stage_chunks(
+        self, executor: Optional[Executor] = None
+    ) -> AsyncIterator[BufferType]:
+        """Dim-0 chunk stream whose concatenation is byte-identical to
+        :meth:`stage_buffer`'s output. Per chunk: slice on device, hint the
+        NEXT chunk's D2H before resolving this one (so the transfer engine
+        streams back-to-back), then serialize. Framed compression emits
+        whole ``frame_bytes`` frames and carries the inter-chunk remainder,
+        so the frame layout (and the published ``frame_sizes``) matches the
+        non-streamed path exactly."""
+        serializer = self.entry.serializer
+        framed = serializer != Serializer.RAW
+        try:
+            ranges = self._stream_row_ranges()
+            arr = self.arr
+            is_jax = _is_jax_array(arr)
+            host_full: Optional[np.ndarray] = None
+            if not is_jax:
+                host_full = np.asarray(arr)
+                if not host_full.flags["C_CONTIGUOUS"]:
+                    host_full = np.ascontiguousarray(host_full)
+            loop = asyncio.get_running_loop()
+            level = self.compression_level
+            frame_bytes = self.entry.frame_bytes
+            carry = bytearray()  # raw tail short of a full compression frame
+            sizes: List[int] = []
+            # Pre-hinted device slices, two chunks ahead of the resolve so
+            # transfers pipeline on high-latency links (one-ahead leaves the
+            # link idle for a round-trip between chunks). Bounded depth: each
+            # hinted slice caches its host bytes, so the look-ahead is part
+            # of the stream's RAM footprint.
+            hinted: deque = deque()
+            if self._first_slice is not None and (
+                not ranges
+                or int(self._first_slice.shape[0]) != ranges[0][1] - ranges[0][0]
+            ):
+                # Chunk knob changed between capture and drain: the
+                # pre-hinted slice no longer matches the first range.
+                self._first_slice = None
+            if self._first_slice is not None:
+                hinted.append(self._first_slice)
+                self._first_slice = None
+            _HINT_AHEAD = 2
+            for i, (r0, r1) in enumerate(ranges):
+                if is_jax:
+                    while len(hinted) < _HINT_AHEAD + 1 and i + len(
+                        hinted
+                    ) < len(ranges):
+                        nr0, nr1 = ranges[i + len(hinted)]
+                        s = arr[nr0:nr1]
+                        hint_copy_to_host(s)
+                        hinted.append(s)
+                    cur = hinted.popleft()
+                    host = await _traced_to_host(
+                        cur, executor, self.entry.location, _nbytes_of(cur)
+                    )
+                    if not host.flags["C_CONTIGUOUS"]:
+                        host = np.ascontiguousarray(host)
+                else:
+                    host = host_full[r0:r1]
+                view = array_as_bytes_view(host)
+                if not framed:
+                    yield view
+                    continue
+                carry.extend(view)
+                nframes = len(carry) // frame_bytes
+                if i + 1 == len(ranges):
+                    # Last chunk: flush everything, incl. the short tail.
+                    block = bytes(carry)
+                    del carry[:]
+                elif nframes == 0:
+                    continue
+                else:
+                    block = bytes(memoryview(carry)[: nframes * frame_bytes])
+                    del carry[: nframes * frame_bytes]
+
+                def compress_block(block=block):
+                    return compress_framed(block, serializer, level, frame_bytes)
+
+                if executor is not None:
+                    payload, fsizes = await loop.run_in_executor(
+                        executor, compress_block
+                    )
+                else:
+                    payload, fsizes = compress_block()
+                sizes.extend(fsizes)
+                if payload:
+                    yield payload
+            if framed:
+                # Publish for the companion FrameTableStager (same pipeline).
+                self.frame_sizes = sizes
+        except BaseException as e:  # noqa: BLE001 - published, then re-raised
+            if self.entry.frame_bytes:
+                self.frame_error = e
+            raise
+
     def start_d2h_hint(self) -> None:
-        if _is_jax_array(self.arr):
-            try:
-                self.arr.copy_to_host_async()
-            except Exception:  # pragma: no cover - platform-specific hint
-                pass
+        if not _is_jax_array(self.arr):
+            return
+        if knobs.is_stream_writes_enabled() and self.can_stream():
+            # This request will (almost certainly) stream: hint only the
+            # FIRST stream chunk's slice. Hinting the whole array would pull
+            # every byte into jax's host cache AND the per-chunk slices
+            # would transfer again at stage time — 2x the link traffic on
+            # exactly the drains streaming exists to speed up. The later
+            # chunks hint themselves one ahead inside stage_chunks; host
+            # RAM stays bounded by the stream depth instead of the eager
+            # whole-state prefetch.
+            if self._first_slice is None:
+                r0, r1 = self._stream_row_ranges()[0]
+                self._first_slice = self.arr[r0:r1]
+                hint_copy_to_host(self._first_slice)
+            return
+        hint_copy_to_host(self.arr)
 
 
 class PollingTableStager(BufferStager):
@@ -359,7 +552,7 @@ class FramedSliceConsumer(BufferConsumer):
                 memoryview(raw)[off : off + (self.raw_end - self.raw_begin)]
             )
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         if executor is not None:
             await loop.run_in_executor(executor, work)
         else:
@@ -438,7 +631,7 @@ class ArrayBufferConsumer(BufferConsumer):
                 src = pickle.loads(bytes(buf))
             np.copyto(self.target, src, casting="no")
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         if executor is not None:
             await loop.run_in_executor(executor, work)
         else:
@@ -469,7 +662,7 @@ class ChunkedReadConsumer(BufferConsumer):
         def work() -> None:
             flat[begin:end] = np.frombuffer(memoryview(buf), dtype=np.uint8)
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         if executor is not None:
             await loop.run_in_executor(executor, work)
         else:

@@ -6,11 +6,15 @@ partitioner split a replicated array's write load across processes at chunk
 granularity. On TPU the per-chunk slice ``arr[r0:r1]`` is an XLA device op, so
 chunk transfers stream out of HBM back-to-back without a full host-side copy
 first.
+
+The row-range math (``chunk_row_ranges``) lives in ``array.py`` and is shared
+with the streaming stager: each chunk OBJECT produced here is itself streamed
+(at the finer ``TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES`` grain) when the
+scheduler routes it through a storage write stream.
 """
 
 from __future__ import annotations
 
-import math
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -18,7 +22,9 @@ import numpy as np
 from ..io_types import ReadReq, WriteReq
 from ..manifest import ChunkedArrayEntry, Shard
 from ..utils import knobs
-from .array import ArrayIOPreparer
+from .array import ArrayIOPreparer, chunk_row_ranges
+
+__all__ = ["should_chunk", "chunk_row_ranges", "ChunkedArrayIOPreparer"]
 
 
 def should_chunk(arr: Any) -> bool:
@@ -28,25 +34,6 @@ def should_chunk(arr: Any) -> bool:
         and arr.shape[0] > 1
         and nbytes > knobs.get_max_chunk_size_bytes()
     )
-
-
-def chunk_row_ranges(shape, itemsize: int, max_chunk_bytes: int) -> List[Tuple[int, int]]:
-    """Row ranges [r0, r1) per chunk, each chunk <= max_chunk_bytes (when a
-    single row fits)."""
-    dim0 = int(shape[0])
-    row_bytes = itemsize * int(np.prod(shape[1:])) if len(shape) > 1 else itemsize
-    rows_per_chunk = max(1, max_chunk_bytes // max(row_bytes, 1))
-    n_chunks = math.ceil(dim0 / rows_per_chunk)
-    # Even spread so the last chunk isn't tiny.
-    base = dim0 // n_chunks
-    extra = dim0 % n_chunks
-    ranges = []
-    r0 = 0
-    for i in range(n_chunks):
-        rows = base + (1 if i < extra else 0)
-        ranges.append((r0, r0 + rows))
-        r0 += rows
-    return ranges
 
 
 class ChunkedArrayIOPreparer:

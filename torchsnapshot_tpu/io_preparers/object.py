@@ -27,7 +27,7 @@ class ObjectBufferStager(BufferStager):
         self.obj = obj
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         dump = lambda: pickle.dumps(self.obj, protocol=pickle.HIGHEST_PROTOCOL)
         if executor is not None:
             return await loop.run_in_executor(executor, dump)

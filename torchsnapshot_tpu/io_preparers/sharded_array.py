@@ -200,7 +200,7 @@ class ShardedArrayBufferConsumer(BufferConsumer):
                 src_view = src[src_slices] if src_slices else src
                 np.copyto(dst_view, src_view, casting="no")
 
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         if executor is not None:
             await loop.run_in_executor(executor, work)
         else:

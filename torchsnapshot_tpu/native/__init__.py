@@ -110,6 +110,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib._tss_has_digest = True
     except AttributeError:  # pragma: no cover - stale cached .so
         lib._tss_has_digest = False
+    try:
+        lib.tss_write_at.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+        ]
+        lib.tss_write_at.restype = ctypes.c_int
+        lib._tss_has_write_at = True
+    except AttributeError:  # pragma: no cover - stale cached .so
+        lib._tss_has_write_at = False
     return lib
 
 
@@ -261,6 +275,40 @@ def write_file_digest(
     if rc < 0:
         raise OSError(-rc, os.strerror(-rc), path)
     return [crc.value, mv.nbytes, None]
+
+
+def supports_write_at(lib: ctypes.CDLL) -> bool:
+    """Whether the loaded engine has the streamed positioned-write API (a
+    stale cached ``.so`` built from older source may not)."""
+    return bool(getattr(lib, "_tss_has_write_at", False))
+
+
+def write_at(
+    lib: ctypes.CDLL,
+    path: str,
+    buf,
+    *,
+    offset: int,
+    direct: bool,
+    chunk_bytes: int,
+    truncate_to: int = -1,
+) -> None:
+    """Write ``buf`` at byte ``offset`` of ``path`` (created, not truncated,
+    on open). O_DIRECT engages only for sector-aligned offset+length —
+    streamed appends keep their unaligned tail in Python and flush it here
+    buffered at commit, with ``truncate_to`` setting the final size."""
+    mv = _as_uint8_view(buf)
+    rc = lib.tss_write_at(
+        os.fsencode(path),
+        _buf_address(mv),
+        mv.nbytes,
+        offset,
+        1 if direct else 0,
+        chunk_bytes,
+        truncate_to,
+    )
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
 
 
 def read_into(

@@ -194,6 +194,57 @@ def get_process_memory_budget_bytes(coordinator=None) -> int:
     return min(budget, _MAX_PER_RANK_MEMORY_BUDGET_BYTES)
 
 
+class PipelinePools:
+    """The thread pools one take/restore's pipelines share: a staging
+    executor (D2H + serialize), a hash pool (checksums/dedup digests), and
+    a consuming executor (deserialize + scatter on restore).
+
+    One instance serves every pipeline of the same operation — a restore's
+    per-stateful read pipelines, or a take's write pipeline plus any reads
+    it issues — instead of each constructing (and tearing down) fresh pools.
+    ``shutdown(cancel_queued=True)`` is the error path: queued thunks are
+    cancelled so they don't run against a torn-down pipeline.
+    """
+
+    def __init__(self) -> None:
+        self._staging: Optional[ThreadPoolExecutor] = None
+        self._hash: Optional[ThreadPoolExecutor] = None
+        self._consuming: Optional[ThreadPoolExecutor] = None
+
+    def staging_executor(self) -> ThreadPoolExecutor:
+        if self._staging is None:
+            self._staging = ThreadPoolExecutor(
+                max_workers=knobs.get_staging_threads(),
+                thread_name_prefix="tss-stage",
+            )
+        return self._staging
+
+    def hash_executor(self) -> ThreadPoolExecutor:
+        # As wide as staging: hashing (~0.9 GB/s/thread for crc+sha256)
+        # must not become the bottleneck of incremental takes, where it
+        # replaces the skipped storage write.
+        if self._hash is None:
+            self._hash = ThreadPoolExecutor(
+                max_workers=knobs.get_staging_threads(),
+                thread_name_prefix="tss-hash",
+            )
+        return self._hash
+
+    def consuming_executor(self) -> ThreadPoolExecutor:
+        if self._consuming is None:
+            self._consuming = ThreadPoolExecutor(
+                max_workers=knobs.get_consuming_threads(),
+                thread_name_prefix="tss-consume",
+            )
+        return self._consuming
+
+    def shutdown(self, cancel_queued: bool = False) -> None:
+        for ex in (self._staging, self._hash, self._consuming):
+            if ex is not None:
+                ex.shutdown(wait=False, cancel_futures=cancel_queued)
+        self._staging = self._hash = self._consuming = None
+
+
 class _Budget:
     def __init__(self, total: int) -> None:
         self.total = total
@@ -269,8 +320,13 @@ class _WritePipeline:
         base_loader: Optional[
             Callable[[], Optional[Tuple[str, Dict[str, list]]]]
         ] = None,
+        pools: Optional[PipelinePools] = None,
     ) -> None:
         self.storage = storage
+        # Thread pools: shared with the operation's other pipelines when the
+        # caller passes them, private (and torn down at drain end) otherwise.
+        self._owns_pools = pools is None
+        self.pools = pools if pools is not None else PipelinePools()
         # Resolved lazily (on the background drain for async takes) so
         # reading the base snapshot's metadata/sidecars never extends
         # async_take's stall; after resolution base is
@@ -302,6 +358,10 @@ class _WritePipeline:
         self.staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int, float]] = {}
         self.ready_for_io: Deque[Tuple[str, object]] = deque()
         self.io_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
+        # Streamed requests: one task drives the whole chunk stream
+        # (staging producer + append consumer + commit) and does its own
+        # per-chunk budget accounting.
+        self.stream_tasks: Dict[asyncio.Task, Tuple[WriteReq, float]] = {}
         self.bytes_staged = 0
         self.staged_ts: Optional[float] = None
         self.executor: Optional[ThreadPoolExecutor] = None
@@ -334,13 +394,16 @@ class _WritePipeline:
         self.pipeline_stats: Dict[str, float] = {}
 
     def _record_task(self, kind: str, t0: float, path: str, nbytes: int) -> None:
-        """One finished staging/io task: record its interval (stats) and,
-        when telemetry is on, the corresponding scheduler span."""
+        """One finished staging/io task (or streamed chunk): record its
+        interval (stats) and, when telemetry is on, the corresponding
+        scheduler span. ``stream_chunk`` intervals join the STAGING stream
+        and a streamed request's appends join the IO stream, so the
+        overlap stats attribute streamed chunks to both streams."""
         t1 = time.monotonic()
-        if kind == "stage":
-            self._stage_intervals.append((t0, t1))
-        else:
+        if kind == "io":
             self._io_intervals.append((t0, t1))
+        else:  # "stage" | "stream_chunk"
+            self._stage_intervals.append((t0, t1))
         tm = self._tm
         if tm is not None:
             tm.add_span(
@@ -357,6 +420,7 @@ class _WritePipeline:
                 "pending": len(self.pending),
                 "deferred": len(self.deferred),
                 "staging": len(self.staging_tasks),
+                "streaming": len(self.stream_tasks),
                 "ready_for_io": len(self.ready_for_io),
                 "io": len(self.io_tasks),
             },
@@ -364,21 +428,63 @@ class _WritePipeline:
             self.budget,
         )
 
+    def _stream_eligible(self, req: WriteReq) -> bool:
+        """Whether this request goes through the chunk-streaming path:
+        stager and storage both support it, it is big enough that a second
+        chunk exists to overlap with, and the take has no incremental base
+        (dedup must see the whole object's digest BEFORE deciding link-in
+        vs write; a stream has already appended by then)."""
+        if not knobs.is_stream_writes_enabled():
+            return False
+        if not getattr(self.storage, "supports_streaming", False):
+            return False
+        if self._base_loader is not None:
+            return False
+        stager = req.buffer_stager
+        if stager.get_staging_cost_bytes() < 2 * knobs.get_stream_chunk_bytes():
+            return False
+        return stager.can_stream()
+
     def _dispatch_staging(self) -> None:
         if self.executor is None:
-            self.executor = ThreadPoolExecutor(
-                max_workers=knobs.get_staging_threads()
-            )
+            self.executor = self.pools.staging_executor()
+        max_io = knobs.get_max_concurrent_io_for(self.storage)
         while self.pending:
-            cost = self.pending[0].buffer_stager.get_staging_cost_bytes()
+            req = self.pending[0]
+            stream = self._stream_eligible(req)
+            cost = req.buffer_stager.get_staging_cost_bytes()
+            if stream:
+                if len(self.stream_tasks) >= max_io:
+                    break  # wait for a stream slot
+                # Streamed requests are admitted at their steady-state
+                # footprint (inflight x chunk), not their full size — that
+                # is the RAM win; _stream_one re-debits per chunk. Stagers
+                # that materialize one full host buffer and stream views of
+                # it stay admitted at full cost.
+                if not req.buffer_stager.stream_holds_full_buffer:
+                    cost = min(
+                        cost,
+                        knobs.get_stream_chunk_bytes()
+                        * knobs.get_stream_inflight(),
+                    )
             over_budget = cost > self.budget.available
-            pipeline_empty = not self.staging_tasks and not self.io_tasks
+            pipeline_empty = (
+                not self.staging_tasks
+                and not self.io_tasks
+                and not self.stream_tasks
+            )
             if over_budget and not pipeline_empty:
                 break
-            req = self.pending.popleft()
+            self.pending.popleft()
             self.budget.debit(cost)
-            task = asyncio.ensure_future(req.buffer_stager.stage_buffer(self.executor))
-            self.staging_tasks[task] = (req, cost, time.monotonic())
+            if stream:
+                task = asyncio.ensure_future(self._stream_one(req, cost))
+                self.stream_tasks[task] = (req, time.monotonic())
+            else:
+                task = asyncio.ensure_future(
+                    req.buffer_stager.stage_buffer(self.executor)
+                )
+                self.staging_tasks[task] = (req, cost, time.monotonic())
 
     def _dispatch_io(self) -> None:
         max_io = knobs.get_max_concurrent_io_for(self.storage)
@@ -387,6 +493,137 @@ class _WritePipeline:
             nbytes = memoryview(buf).nbytes
             task = asyncio.ensure_future(self._write_one(path, buf))
             self.io_tasks[task] = (nbytes, time.monotonic(), path)
+
+    async def _stream_one(self, req: WriteReq, admitted_cost: int) -> None:
+        """Drive ONE streamed request end to end: a staging producer
+        (``stage_chunks``) and an append consumer connected by a bounded
+        queue, so the storage write of chunk *k* overlaps the
+        D2H/serialization of chunk *k+1* — the intra-request half of the
+        paper's overlap thesis. Budget accounting is per chunk: debit when
+        a chunk is staged, credit when ITS append completes, so peak host
+        RAM for the request is ~``chunk_bytes x inflight`` instead of its
+        full size. Per-object digests fold incrementally (running crc32 +
+        sha256 over the chunk sequence == the whole object's digest), and a
+        mid-stream failure aborts the storage stream — no partial object is
+        ever committed."""
+        stager = req.buffer_stager
+        budget = self.budget
+        chunk_est = knobs.get_stream_chunk_bytes()
+        inflight = knobs.get_stream_inflight()
+        holds_full = stager.stream_holds_full_buffer
+        if not holds_full:
+            # Hand the admission reservation over to per-chunk accounting.
+            budget.credit(admitted_cost)
+            admitted_cost = 0
+        outstanding = 0  # bytes debited for chunks whose append hasn't landed
+        want_digest = knobs.is_checksums_enabled()
+        sha = hashlib.sha256() if (want_digest and self._want_sha) else None
+        crc = 0
+        total = 0
+        chunks = 0
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, inflight))
+        _END = object()
+        try:
+            stream = await self.storage.write_stream(req.path)
+        except BaseException:
+            if holds_full and admitted_cost:
+                budget.credit(admitted_cost)
+            raise
+
+        async def produce() -> None:
+            nonlocal outstanding, chunks
+            agen = stager.stage_chunks(self.executor)
+            try:
+                while True:
+                    if not holds_full:
+                        budget.debit(chunk_est)
+                        outstanding += chunk_est
+                    t0 = time.monotonic()
+                    try:
+                        buf = await agen.__anext__()
+                    except StopAsyncIteration:
+                        if not holds_full:
+                            budget.credit(chunk_est)
+                            outstanding -= chunk_est
+                        break
+                    nbytes = memoryview(buf).nbytes
+                    if not holds_full:
+                        # Correct the estimate to the chunk's real size.
+                        budget.credit(chunk_est)
+                        budget.debit(nbytes)
+                        outstanding += nbytes - chunk_est
+                    chunks += 1
+                    self._record_task("stream_chunk", t0, req.path, nbytes)
+                    await queue.put((buf, nbytes))
+            finally:
+                await agen.aclose()
+            # Signal completion OUTSIDE the finally: on the error path the
+            # consumer may already be dead with the queue full, and a
+            # cancelled producer blocking here again would deadlock the
+            # cleanup gather (the consumer is cancelled alongside us there,
+            # so the sentinel is only needed on normal completion).
+            await queue.put((_END, 0))
+
+        async def consume() -> None:
+            nonlocal crc, total, outstanding
+            while True:
+                buf, nbytes = await queue.get()
+                if buf is _END:
+                    return
+                if want_digest:
+                    # Fold this chunk into the object's running digest on
+                    # the hash pool (GIL released); sequential per stream,
+                    # so chunk order — and thus the digest — is exact.
+                    if self._crc_executor is None:
+                        self._crc_executor = self.pools.hash_executor()
+
+                    def fold(mv=memoryview(buf), c=crc):
+                        if sha is not None:
+                            sha.update(mv)
+                        return zlib.crc32(mv, c)
+
+                    crc = await loop.run_in_executor(self._crc_executor, fold)
+                t0 = time.monotonic()
+                await stream.append(buf)
+                self._record_task("io", t0, req.path, nbytes)
+                total += nbytes
+                if not holds_full:
+                    budget.credit(nbytes)
+                    outstanding -= nbytes
+
+        ptask = asyncio.ensure_future(produce())
+        ctask = asyncio.ensure_future(consume())
+        try:
+            await asyncio.gather(ptask, ctask)
+            t0 = time.monotonic()
+            await stream.commit()
+            self._record_task("io", t0, req.path, 0)
+        except BaseException:
+            for t in (ptask, ctask):
+                t.cancel()
+            await asyncio.gather(ptask, ctask, return_exceptions=True)
+            try:
+                await stream.abort()
+            except Exception:  # noqa: BLE001 - the original failure wins
+                logger.warning(
+                    "failed to abort write stream for %s", req.path,
+                    exc_info=True,
+                )
+            raise
+        finally:
+            if outstanding:
+                budget.credit(outstanding)
+                outstanding = 0
+            if holds_full and admitted_cost:
+                budget.credit(admitted_cost)
+                admitted_cost = 0
+        self.bytes_staged += total
+        telemetry.counter_add("scheduler.stream_chunks", chunks)
+        if want_digest:
+            self.checksums[req.path] = [
+                crc, total, sha.hexdigest() if sha is not None else None
+            ]
 
     async def _write_one(self, path: str, buf) -> None:
         if knobs.is_checksums_enabled():
@@ -397,14 +634,13 @@ class _WritePipeline:
             # Recorded per *storage object* (sidecar value
             # [crc32, size, sha256]) so ``Snapshot.verify()`` can audit
             # files without the manifest and incremental takes can dedup.
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             if self._crc_executor is None:
-                # As wide as staging: hashing (~0.9 GB/s/thread for
-                # crc+sha256) must not become the bottleneck of incremental
-                # takes, where it replaces the skipped storage write.
-                self._crc_executor = ThreadPoolExecutor(
-                    max_workers=knobs.get_staging_threads()
-                )
+                # Hashing runs on the operation's shared hash pool so a
+                # staging pool saturated with multi-second D2H jobs can't
+                # head-of-line block storage writes behind queued staging
+                # work (width: see PipelinePools.hash_executor).
+                self._crc_executor = self.pools.hash_executor()
             if not self._base_resolved:
                 async with self._base_lock:
                     if not self._base_resolved:
@@ -498,6 +734,11 @@ class _WritePipeline:
                 self.budget.credit(cost)
                 self.budget.debit(nbytes)
                 self.ready_for_io.append((req.path, buf))
+            elif task in self.stream_tasks:
+                # Intervals, budget, and byte counts were recorded inside
+                # _stream_one chunk by chunk; only failures remain.
+                self.stream_tasks.pop(task)
+                task.result()  # propagate failures
             else:
                 nbytes, t0, path = self.io_tasks.pop(task)
                 task.result()  # propagate failures
@@ -513,9 +754,15 @@ class _WritePipeline:
         try:
             if self.pending:
                 self._dispatch_staging()
-            while self.staging_tasks or self.pending:
+            # Stream tasks admitted here (sync takes' big host arrays) must
+            # finish before the capture point too: their source is read
+            # until the last chunk stages, and by the time they complete
+            # the bytes are durably written — strictly stronger capture.
+            while self.staging_tasks or self.pending or self.stream_tasks:
                 done, _ = await asyncio.wait(
-                    set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
+                    set(self.staging_tasks.keys())
+                    | set(self.io_tasks.keys())
+                    | set(self.stream_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
                     # Bounded so the reporter fires during a stall (when no
                     # task completes, wait returns with done == set()).
@@ -526,7 +773,7 @@ class _WritePipeline:
                 self._dispatch_staging()
                 self._report()
         except BaseException:
-            self._shutdown_executor()
+            self._shutdown_executor(failed=True)
             raise
         finally:
             self._windows.append((window_t0, time.monotonic()))
@@ -547,9 +794,17 @@ class _WritePipeline:
             if self.pending or self.staging_tasks:
                 self._dispatch_staging()
             self._dispatch_io()
-            while self.staging_tasks or self.pending or self.io_tasks or self.ready_for_io:
+            while (
+                self.staging_tasks
+                or self.pending
+                or self.io_tasks
+                or self.ready_for_io
+                or self.stream_tasks
+            ):
                 done, _ = await asyncio.wait(
-                    set(self.staging_tasks.keys()) | set(self.io_tasks.keys()),
+                    set(self.staging_tasks.keys())
+                    | set(self.io_tasks.keys())
+                    | set(self.stream_tasks.keys()),
                     return_when=asyncio.FIRST_COMPLETED,
                     # Bounded so the reporter fires during a stall (when no
                     # task completes, wait returns with done == set()).
@@ -559,7 +814,11 @@ class _WritePipeline:
                 self._dispatch_io()
                 self._dispatch_staging()
                 self._report()
-                if not self.staging_tasks and not self.pending:
+                if (
+                    not self.staging_tasks
+                    and not self.pending
+                    and not self.stream_tasks
+                ):
                     self._mark_staged()
             # The sidecar write/delete below is real storage time: recorded
             # as an io interval so wall_s (and the drain rate derived from
@@ -603,8 +862,12 @@ class _WritePipeline:
                         self.rank,
                         exc_info=True,
                     )
-        finally:
-            self._shutdown_executor()
+        except BaseException:
+            # Error path: cancel queued staging/hash thunks so they don't
+            # run against a torn-down pipeline.
+            self._shutdown_executor(failed=True)
+            raise
+        self._shutdown_executor()
 
         drain_window = (drain_t0, time.monotonic())
         self._windows.append(drain_window)
@@ -656,7 +919,12 @@ class _WritePipeline:
             )
 
     def _mark_staged(self) -> None:
-        if self.staged_ts is None and not self.staging_tasks and not self.pending:
+        if (
+            self.staged_ts is None
+            and not self.staging_tasks
+            and not self.pending
+            and not self.stream_tasks
+        ):
             self.staged_ts = time.monotonic()
             logger.info(
                 "Rank %d staged %.2f GB in %.2fs",
@@ -665,13 +933,15 @@ class _WritePipeline:
                 self.staged_ts - self.begin_ts,
             )
 
-    def _shutdown_executor(self) -> None:
-        if self.executor is not None:
-            self.executor.shutdown(wait=False)
-            self.executor = None
-        if self._crc_executor is not None:
-            self._crc_executor.shutdown(wait=False)
-            self._crc_executor = None
+    def _shutdown_executor(self, failed: bool = False) -> None:
+        """Release the thread pools. On the error path, queued thunks are
+        cancelled (``cancel_futures``) so no staging/hash work runs against
+        a torn-down pipeline; shared pools (``_owns_pools`` False) are only
+        torn down on failure — their owner closes them on success."""
+        self.executor = None
+        self._crc_executor = None
+        if self._owns_pools or failed:
+            self.pools.shutdown(cancel_queued=failed)
 
 
 class PendingIOWork:
@@ -712,14 +982,21 @@ async def execute_write_reqs(
     base_loader: Optional[
         Callable[[], Optional[Tuple[str, Dict[str, list]]]]
     ] = None,
+    pools: Optional[PipelinePools] = None,
 ) -> PendingIOWork:
     """Runs to the capture point (all non-deferred requests staged) and
     returns a :class:`PendingIOWork` that drains the rest (deferred staging +
     all storage I/O). ``base_loader`` lazily yields (base snapshot root,
     merged digest map) for incremental takes: byte-identical objects are
-    hard-linked, not rewritten."""
+    hard-linked, not rewritten. ``pools``: thread pools shared with the
+    operation's other pipelines (owned, and torn down, by the caller)."""
     pipeline = _WritePipeline(
-        write_reqs, storage, memory_budget_bytes, rank, base_loader=base_loader
+        write_reqs,
+        storage,
+        memory_budget_bytes,
+        rank,
+        base_loader=base_loader,
+        pools=pools,
     )
     await pipeline.run_until_staged()
     return PendingIOWork(pipeline)
@@ -734,10 +1011,16 @@ def sync_execute_write_reqs(
     base_loader: Optional[
         Callable[[], Optional[Tuple[str, Dict[str, list]]]]
     ] = None,
+    pools: Optional[PipelinePools] = None,
 ) -> PendingIOWork:
     return event_loop.run_until_complete(
         execute_write_reqs(
-            write_reqs, storage, memory_budget_bytes, rank, base_loader=base_loader
+            write_reqs,
+            storage,
+            memory_budget_bytes,
+            rank,
+            base_loader=base_loader,
+            pools=pools,
         )
     )
 
@@ -747,6 +1030,7 @@ async def execute_read_reqs(
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
+    pools: Optional[PipelinePools] = None,
 ) -> None:
     begin_ts = time.monotonic()
     budget = _Budget(memory_budget_bytes)
@@ -756,7 +1040,12 @@ async def execute_read_reqs(
     io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int, float]] = {}
     consume_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
     bytes_read = 0
-    executor = ThreadPoolExecutor(max_workers=knobs.get_consuming_threads())
+    # One consuming pool per operation: restores with many statefuls reuse
+    # the caller's pools instead of constructing one per read pipeline.
+    owns_pools = pools is None
+    if owns_pools:
+        pools = PipelinePools()
+    executor = pools.consuming_executor()
     reporter = _ProgressReporter(rank, "read")
     tm = telemetry.get_active()
 
@@ -830,8 +1119,14 @@ async def execute_read_reqs(
                 bytes_read,
                 budget,
             )
-    finally:
-        executor.shutdown(wait=False)
+    except BaseException:
+        # Error path: queued consumer thunks must not run against a
+        # torn-down pipeline.
+        pools.shutdown(cancel_queued=True)
+        raise
+    else:
+        if owns_pools:
+            pools.shutdown()
 
     elapsed = time.monotonic() - begin_ts
     telemetry.counter_add("scheduler.bytes_read", bytes_read)
@@ -852,7 +1147,10 @@ def sync_execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
+    pools: Optional[PipelinePools] = None,
 ) -> None:
     event_loop.run_until_complete(
-        execute_read_reqs(read_reqs, storage, memory_budget_bytes, rank)
+        execute_read_reqs(
+            read_reqs, storage, memory_budget_bytes, rank, pools=pools
+        )
     )

@@ -72,6 +72,7 @@ from .rng_state import RNGState
 from .scheduler import (
     CHECKSUM_FILE_PREFIX,
     PendingIOWork,
+    PipelinePools,
     get_process_memory_budget_bytes,
     sync_execute_read_reqs,
     sync_execute_write_reqs,
@@ -675,6 +676,9 @@ class Snapshot:
         # in a fresh (restore-only) process.
         memory_budget = get_process_memory_budget_bytes(coord)
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
+        # One pool set for every per-stateful read pipeline of this restore
+        # (instead of a fresh ThreadPoolExecutor per stateful).
+        pools = PipelinePools()
         try:
             with telemetry.span("restore.read_metadata", cat="restore"):
                 metadata = self._read_metadata(storage, event_loop)
@@ -719,12 +723,14 @@ class Snapshot:
                             storage=storage,
                             memory_budget=memory_budget,
                             event_loop=event_loop,
+                            pools=pools,
                         )
             # Single post-load barrier: no rank observes restore() as
             # complete (and e.g. deletes/overwrites the snapshot, or
             # reports readiness) while a peer is still reading storage.
             coord.barrier()
         finally:
+            pools.shutdown()
             storage.sync_close(event_loop)
             event_loop.close()
             _finish_telemetry(tm, tm_prev, rank)
@@ -737,6 +743,7 @@ class Snapshot:
         storage: StoragePlugin,
         memory_budget: int,
         event_loop: asyncio.AbstractEventLoop,
+        pools: Optional[PipelinePools] = None,
     ) -> None:
         # Per-read cap = the whole process budget: a single object/shard
         # larger than the budget would otherwise be admitted whole through
@@ -851,6 +858,7 @@ class Snapshot:
             memory_budget_bytes=memory_budget,
             rank=get_coordinator(self._coordinator).get_rank(),
             event_loop=event_loop,
+            pools=pools,
         )
         # Overlap on: a successful pipeline consumed every read, so every
         # countdown fired and finalized its entry inline; nothing remains.

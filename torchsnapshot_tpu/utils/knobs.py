@@ -479,6 +479,53 @@ def override_plan_cache_size(value: int):
     return _override_env(_ENV_PLAN_CACHE_SIZE, str(value))
 
 
+_ENV_STREAM_WRITES = "TORCHSNAPSHOT_TPU_STREAM_WRITES"
+_ENV_STREAM_CHUNK = "TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES"
+_ENV_STREAM_INFLIGHT = "TORCHSNAPSHOT_TPU_STREAM_INFLIGHT"
+
+_DEFAULT_STREAM_CHUNK_BYTES = 32 * 1024 * 1024
+
+
+def is_stream_writes_enabled() -> bool:
+    """Stream large write requests chunk-by-chunk through the scheduler.
+
+    When on (the default), a request whose stager supports incremental
+    staging (dim-0 chunkable raw/framed arrays, batched slabs) and whose
+    storage plugin supports appending writes is staged as a chunk stream:
+    the storage write for chunk *k* runs while chunk *k+1* is still in
+    D2H/compression, and the memory budget is debited/credited per chunk —
+    peak host RAM for one large array is ~``STREAM_CHUNK_BYTES x
+    STREAM_INFLIGHT`` instead of its full size. Off = round-5 behavior
+    (stage the whole request, then write it)."""
+    return os.environ.get(_ENV_STREAM_WRITES, "1") not in ("0", "false", "False")
+
+
+def get_stream_chunk_bytes() -> int:
+    """Target bytes per streamed chunk (default 32 MB). Smaller chunks
+    overlap sooner and bound RAM tighter but pay more per-append overhead;
+    keep well above the storage plugin's per-op latency·bandwidth product."""
+    return max(1, _get_int(_ENV_STREAM_CHUNK, _DEFAULT_STREAM_CHUNK_BYTES))
+
+
+def get_stream_inflight() -> int:
+    """Max staged-but-unwritten chunks per streamed request (default 4).
+    This is the streaming pipeline's depth: staging may run at most this
+    many chunks ahead of the storage appends."""
+    return max(1, _get_int(_ENV_STREAM_INFLIGHT, 4))
+
+
+def override_stream_writes(enabled: bool):
+    return _override_env(_ENV_STREAM_WRITES, "1" if enabled else "0")
+
+
+def override_stream_chunk_bytes(value: int):
+    return _override_env(_ENV_STREAM_CHUNK, str(value))
+
+
+def override_stream_inflight(value: int):
+    return _override_env(_ENV_STREAM_INFLIGHT, str(value))
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
