@@ -387,6 +387,45 @@ def main() -> None:
         }
         log(f"stream A/B medians: on={stream_ab['on']} off={stream_ab['off']}")
 
+        # ---- persisted-telemetry summary: the async checkpoint carries its
+        # own attribution (.telemetry/rank_0.json written by the drain);
+        # embed the aggregated view so the perf trajectory's numbers come
+        # with phase/drain/byte attribution from the snapshot itself.
+        telemetry_summary = None
+        try:
+            from torchsnapshot_tpu.telemetry import aggregate as tagg
+
+            ws, arts, art_problems = tagg.read_snapshot_artifacts(
+                os.path.join(root, "ckpt_async")
+            )
+            if arts:
+                agg = tagg.aggregate(arts, world_size=ws)
+                rank0 = agg["per_rank"][0]
+                telemetry_summary = {
+                    "phases_s": {
+                        k: round(v["max"], 4) for k, v in agg["phases_s"].items()
+                    },
+                    "drain_stats_s": {
+                        k: round(rank0[k], 2)
+                        for k in (
+                            "wall_s",
+                            "stage_busy_s",
+                            "io_busy_s",
+                            "overlap_s",
+                            "idle_s",
+                        )
+                    },
+                    "bytes_written": agg["totals"]["bytes_written"],
+                    "storage_bytes": agg["storage_bytes"],
+                    "spans_dropped": agg["spans_dropped"],
+                    "artifact_problems": {
+                        str(r): p for r, p in sorted(art_problems.items())
+                    },
+                }
+                log(f"telemetry summary (from persisted artifacts): {telemetry_summary}")
+        except Exception as e:  # diagnostics must never fail the bench
+            log(f"WARNING: telemetry artifact aggregation failed: {e!r}")
+
         # ---- restore bit-exactness via random access into the async ckpt
         snap = Snapshot(os.path.join(root, "ckpt_async"))
         probe = list(params)[-1]
@@ -429,6 +468,7 @@ def main() -> None:
                         "naive_gbps_all": [round(r, 4) for r in naive_rates],
                         "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
                         "restore_bit_exact": ok,
+                        "telemetry": telemetry_summary,
                         "baseline": (
                             "reference-style async_take must capture to host RAM "
                             "before returning; its stall >= one full D2H transfer "

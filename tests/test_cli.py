@@ -55,6 +55,61 @@ def test_cli_errors_are_clean(snap_path, capsys) -> None:
     assert capsys.readouterr().err.startswith("error:")
 
 
+def test_cli_stats_smoke(snap_path, tmp_path, capsys) -> None:
+    """Tier-1 smoke: stats works from the persisted artifacts alone and
+    prints the per-rank breakdown + straggler line; --trace writes the
+    merged multi-rank Perfetto JSON."""
+    import json
+
+    trace_out = str(tmp_path / "fleet.json")
+    assert main(["stats", snap_path, "--trace", trace_out]) == 0
+    out = capsys.readouterr().out
+    assert "world_size=1" in out
+    assert "rank  wall_s" in out and "straggler: rank 0" in out
+    assert "capture" in out  # phase table
+    assert "storage.fs.write_bytes" in out
+    trace = json.load(open(trace_out))
+    assert {e["pid"] for e in trace["traceEvents"]} == {0}
+
+
+def test_cli_compare_smoke(snap_path, tmp_path, capsys) -> None:
+    other = str(tmp_path / "other")
+    Snapshot.take(
+        other,
+        {"m": StateDict(w=np.ones((3, 4), dtype=np.float32), step=8)},
+    )
+    assert main(["compare", snap_path, other]) == 0
+    out = capsys.readouterr().out
+    assert "wall_s" in out and "B/A" in out
+    assert f"A = {snap_path}" in out
+
+
+def test_cli_stats_prints_truncation_notice(tmp_path, capsys) -> None:
+    """An artifact recording dropped spans makes stats print a truncation
+    notice (satellite: drops are never silent)."""
+    from torchsnapshot_tpu import telemetry
+
+    path = str(tmp_path / "ck")
+    Snapshot.take(
+        path,
+        {"m": StateDict(w=np.arange(64, dtype=np.float32), step=1)},
+        _telemetry=telemetry.Telemetry(capacity=3),
+    )
+    assert main(["stats", path]) == 0
+    out = capsys.readouterr().out
+    assert "truncated" in out and "dropped" in out
+
+
+def test_cli_stats_no_artifacts_is_clean_error(tmp_path, capsys) -> None:
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = str(tmp_path / "bare")
+    with _knobs.override_telemetry_artifacts(False):
+        Snapshot.take(path, {"m": StateDict(step=1)})
+    assert main(["stats", path]) == 2
+    assert "no telemetry artifacts" in capsys.readouterr().err
+
+
 def test_cli_ls_shows_chunk_locations(tmp_path, capsys) -> None:
     from torchsnapshot_tpu.utils import knobs as _knobs
 

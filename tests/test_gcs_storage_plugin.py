@@ -267,6 +267,37 @@ def test_delete(fake_gcs) -> None:
     assert blobs == {}
 
 
+def test_telemetry_artifact_round_trip(fake_gcs) -> None:
+    """Persisted-telemetry leg: the artifact write/read seams the snapshot
+    paths use work through the GCS plugin (fake SDK), and the missing-rank
+    case degrades instead of failing the merge."""
+    import asyncio as _asyncio
+
+    from torchsnapshot_tpu.storage_plugin import write_telemetry_artifact
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+    from torchsnapshot_tpu.telemetry import aggregate as agg_mod
+    from torchsnapshot_tpu.telemetry import artifact as art_mod
+
+    blobs, _ = fake_gcs
+    plugin = GCSStoragePlugin(root="bucket/snap")
+    loop = _asyncio.new_event_loop()
+    try:
+        art = art_mod.build_artifact(op="take", rank=0, world_size=2)
+        assert write_telemetry_artifact(
+            plugin, loop, art_mod.artifact_path(0), art_mod.dumps_artifact(art)
+        )
+        assert "snap/.telemetry/rank_0.json" in blobs
+        artifacts, problems = agg_mod.read_artifacts(plugin, loop, world_size=2)
+    finally:
+        plugin.sync_close(loop)
+        loop.close()
+    assert set(artifacts) == {0} and problems == {1: "missing"}
+    assert artifacts[0]["op"] == "take"
+    assert artifacts[0]["hostname"] == art["hostname"]
+    agg = agg_mod.aggregate(artifacts, world_size=2)
+    assert agg["missing_ranks"] == [1]
+
+
 def test_missing_sdk_raises_clear_error(monkeypatch) -> None:
     import builtins
 

@@ -337,3 +337,46 @@ def test_process_local_sharded_arrays_stay_per_rank(tmp_path) -> None:
     run_with_processes(
         _worker_local_sharded_no_clobber, nproc=2, args=(str(tmp_path),)
     )
+
+
+def _worker_telemetry_artifacts(rank: int, world_size: int, shared: str) -> None:
+    # ISSUE 4 acceptance: a committed multi-rank snapshot carries a
+    # telemetry artifact for EVERY rank (written pre-barrier through the
+    # snapshot's own plugin), and `stats` aggregates them from the
+    # artifacts alone — no live process state.
+    import json
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.telemetry import aggregate as agg_mod
+
+    path = os.path.join(shared, "ckpt_telemetry")
+    sd = StateDict(v=np.full((256,), rank, dtype=np.float32))
+    Snapshot.take(path, {"per_rank": sd})
+    # The commit barrier has passed: every rank's artifact must be visible
+    # to every rank.
+    for r in range(world_size):
+        art_file = os.path.join(path, ".telemetry", f"rank_{r}.json")
+        assert os.path.exists(art_file), art_file
+        art = json.load(open(art_file))
+        assert art["rank"] == r and art["world_size"] == world_size
+        assert art["bytes"]["written"] == art["bytes"]["total"] > 0
+    if rank == 0:
+        ws, artifacts, problems = agg_mod.read_snapshot_artifacts(path)
+        assert ws == world_size and problems == {}
+        agg = agg_mod.aggregate(artifacts, world_size=ws)
+        assert agg["ranks"] == list(range(world_size))
+        assert agg["missing_ranks"] == []
+        assert agg["skew"]["straggler_rank"] in agg["ranks"]
+        assert set(agg["skew"]["barrier_wait_s"]) == set(agg["ranks"])
+        lines = "\n".join(agg_mod.format_stats(agg))
+        for r in range(world_size):
+            assert f"\n{r:4d} " in "\n" + lines  # per-rank row present
+        assert "straggler: rank" in lines
+        # The operator CLI runs off the same artifacts.
+        from torchsnapshot_tpu.__main__ import main as cli_main
+
+        assert cli_main(["stats", path]) == 0
+
+
+def test_telemetry_artifacts_all_ranks(tmp_path) -> None:
+    run_with_processes(_worker_telemetry_artifacts, nproc=2, args=(str(tmp_path),))

@@ -346,12 +346,64 @@ def test_explicit_telemetry_object_no_trace_file(tmp_path) -> None:
 
 
 def test_untraced_take_records_nothing(tmp_path) -> None:
-    """No knob, no _telemetry: the take runs with telemetry fully off."""
+    """No knob, no _telemetry, artifacts off: the take runs with telemetry
+    fully off (persisted artifacts — on by default — otherwise create a
+    session per op so the snapshot is auditable after the fact)."""
     before = Snapshot.last_telemetry
+    app = {"m": StateDict(w=np.arange(64, dtype=np.float32))}
+    with knobs.override_telemetry_artifacts(False):
+        Snapshot.take(str(tmp_path / "ck"), app)
+    assert telemetry.get_active() is None
+    assert Snapshot.last_telemetry is before  # untouched
+
+
+def test_default_take_records_session_for_artifact(tmp_path) -> None:
+    """Artifacts on (the default): every take gets a session, published as
+    last_telemetry, and deactivated on completion."""
     app = {"m": StateDict(w=np.arange(64, dtype=np.float32))}
     Snapshot.take(str(tmp_path / "ck"), app)
     assert telemetry.get_active() is None
-    assert Snapshot.last_telemetry is before  # untouched
+    assert Snapshot.last_telemetry is not None
+    assert Snapshot.last_telemetry.metrics.as_dict()["scheduler.bytes_staged"] == 64 * 4
+
+
+def test_histogram_log_bucket_percentiles() -> None:
+    """p50/p95/p99 from the fixed log buckets are within one bucket's
+    relative width (~19%) of the exact percentiles."""
+    tm = Telemetry()
+    h = tm.metrics.histogram("lat")
+    for v in range(1, 1001):
+        h.observe(float(v))
+    for q, exact in ((50, 500.0), (95, 950.0), (99, 990.0)):
+        est = h.percentile(q)
+        assert exact / 1.25 <= est <= exact * 1.25, (q, est)
+    d = tm.metrics.as_dict()
+    assert d["lat.p50"] == h.percentile(50)
+    assert d["lat.p95"] == h.percentile(95)
+    assert d["lat.p99"] == h.percentile(99)
+    # Percentiles clamp into [min, max]; empty histograms export zeros.
+    assert h.percentile(100) == 1000.0
+    h2 = tm.metrics.histogram("empty")
+    assert h2.percentile(50) == 0.0
+    assert tm.metrics.as_dict()["empty.p99"] == 0.0
+    # Non-positive observations land below every positive bucket.
+    h3 = tm.metrics.histogram("zeros")
+    for v in (0.0, 0.0, 0.0, 8.0):
+        h3.observe(v)
+    assert h3.percentile(50) == 0.0
+    assert h3.percentile(99) == pytest.approx(8.0)
+
+
+def test_session_close_records_spans_dropped_metric(tmp_path) -> None:
+    """A session that dropped spans closes with a telemetry.spans_dropped
+    counter, so truncation rides the metrics dump and the artifact."""
+    tm = Telemetry(capacity=3)
+    app = {"m": StateDict(w=np.arange(64, dtype=np.float32))}
+    Snapshot.take(str(tmp_path / "ck"), app, _telemetry=tm)
+    assert tm.buffer.dropped > 0
+    assert (
+        tm.metrics.as_dict()["telemetry.spans_dropped"] == tm.buffer.dropped
+    )
 
 
 def test_cli_trace_subcommand(tmp_path, capsys) -> None:

@@ -22,6 +22,19 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         from the storage plugin itself, so what you see is what a restore
         pays per request.
 
+    python -m torchsnapshot_tpu stats <snapshot-path> [--trace out.json]
+        Fleet view from the persisted ``.telemetry/rank_*.json`` artifacts
+        alone (no live process needed): per-rank phase/byte breakdown,
+        throughput, straggler identification, and commit-barrier wait
+        attribution. ``--trace`` additionally writes the merged multi-rank
+        Chrome/Perfetto trace (pid = rank). ``--op restore`` reads the
+        restore-side artifacts instead.
+
+    python -m torchsnapshot_tpu compare <a> <b>
+        Side-by-side deltas of two snapshots' aggregated telemetry (phase
+        maxima, bytes, throughput, skew) — how a perf change moved the
+        checkpoint, from the checkpoints themselves.
+
 Works against any storage URL the library supports (local path, gs://,
 s3://).
 """
@@ -157,7 +170,79 @@ def _cmd_trace(args: argparse.Namespace) -> int:
         print("metrics:")
         for k in sorted(metrics):
             print(f"  {k} = {metrics[k]}")
+    if tm.buffer.dropped:
+        print(
+            f"warning: trace truncated — {tm.buffer.dropped} span(s) dropped "
+            f"past the {tm.buffer.capacity}-span buffer capacity"
+        )
     print(f"trace written to {args.output} (open at https://ui.perfetto.dev)")
+    return 0
+
+
+def _cmd_stats(args: argparse.Namespace) -> int:
+    from . import telemetry
+    from .telemetry import aggregate as agg_mod
+
+    with telemetry.span("stats.read_artifacts", cat="cli", path=args.path):
+        world_size, artifacts, problems = agg_mod.read_snapshot_artifacts(
+            args.path, op=args.op
+        )
+    if not artifacts:
+        detail = "; ".join(f"rank {r}: {p}" for r, p in sorted(problems.items()))
+        raise RuntimeError(
+            f"no telemetry artifacts readable under {args.path}/.telemetry "
+            f"({detail or 'none present'}) — the snapshot predates artifact "
+            "persistence or was taken with "
+            "TORCHSNAPSHOT_TPU_TELEMETRY_ARTIFACTS=0"
+        )
+    agg = agg_mod.aggregate(artifacts, world_size=world_size)
+    for line in agg_mod.format_stats(agg):
+        print(line)
+    for r, problem in sorted(problems.items()):
+        if problem != "missing":  # missing ranks already noted by format_stats
+            print(
+                f"note: rank {r} artifact {problem} — aggregation degraded",
+                file=sys.stderr,
+            )
+    if agg["spans_dropped"]:
+        print(
+            f"warning: traces truncated — {agg['spans_dropped']} span(s) "
+            "dropped past the trace-buffer capacity across ranks"
+        )
+    if args.trace:
+        agg_mod.write_merged_chrome_trace(artifacts, args.trace)
+        print(
+            f"multi-rank trace written to {args.trace} "
+            "(pid = rank; open at https://ui.perfetto.dev)"
+        )
+    return 0
+
+
+def _cmd_compare(args: argparse.Namespace) -> int:
+    from . import telemetry
+    from .telemetry import aggregate as agg_mod
+
+    aggs = []
+    for path in (args.a, args.b):
+        with telemetry.span("stats.read_artifacts", cat="cli", path=path):
+            world_size, artifacts, problems = agg_mod.read_snapshot_artifacts(
+                path, op=args.op
+            )
+        if not artifacts:
+            raise RuntimeError(
+                f"no telemetry artifacts readable under {path}/.telemetry"
+            )
+        for r, problem in sorted(problems.items()):
+            print(
+                f"note: {path}: rank {r} artifact {problem} — comparison "
+                "degraded",
+                file=sys.stderr,
+            )
+        aggs.append(agg_mod.aggregate(artifacts, world_size=world_size))
+    for line in agg_mod.diff_stats(aggs[0], aggs[1], label_a="A", label_b="B"):
+        print(line)
+    print(f"A = {args.a}")
+    print(f"B = {args.b}")
     return 0
 
 
@@ -194,6 +279,36 @@ def main(argv=None) -> int:
         help="Chrome/Perfetto trace-event JSON destination (default: trace.json)",
     )
     p_trace.set_defaults(fn=_cmd_trace)
+
+    p_stats = sub.add_parser(
+        "stats",
+        help="fleet view from the snapshot's persisted telemetry artifacts",
+    )
+    p_stats.add_argument("path")
+    p_stats.add_argument(
+        "--op",
+        choices=("take", "restore"),
+        default="take",
+        help="which operation's artifacts to aggregate (default: take)",
+    )
+    p_stats.add_argument(
+        "--trace",
+        default=None,
+        metavar="OUT.json",
+        help="also write the merged multi-rank Perfetto trace (pid = rank)",
+    )
+    p_stats.set_defaults(fn=_cmd_stats)
+
+    p_compare = sub.add_parser(
+        "compare",
+        help="diff two snapshots' aggregated telemetry",
+    )
+    p_compare.add_argument("a")
+    p_compare.add_argument("b")
+    p_compare.add_argument(
+        "--op", choices=("take", "restore"), default="take"
+    )
+    p_compare.set_defaults(fn=_cmd_compare)
 
     args = parser.parse_args(argv)
     try:

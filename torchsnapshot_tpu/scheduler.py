@@ -344,6 +344,16 @@ class _WritePipeline:
         self.rank = rank
         self.begin_ts = time.monotonic()
         self.budget = _Budget(memory_budget_bytes)
+        # Live progress counters (PendingSnapshot.progress()): totals start
+        # as staging-cost estimates and converge on actual bytes as staging
+        # completes, so bytes_written ends equal to the payload total.
+        self.progress = telemetry.ProgressTracker()
+        self.progress.set_totals(
+            requests=len(write_reqs),
+            bytes_=sum(
+                r.buffer_stager.get_staging_cost_bytes() for r in write_reqs
+            ),
+        )
         # Stage big requests first: they dominate the critical path and admit
         # small ones into the leftover budget.
         by_size = sorted(
@@ -414,19 +424,31 @@ class _WritePipeline:
                 {"path": path, "nbytes": nbytes, "rank": self.rank},
             )
 
+    def _occupancy(self) -> Dict[str, int]:
+        """Requests per pipeline stage — the reporter's and the stall
+        watchdog's shared view of where work is sitting."""
+        return {
+            "pending": len(self.pending),
+            "deferred": len(self.deferred),
+            "staging": len(self.staging_tasks),
+            "streaming": len(self.stream_tasks),
+            "ready_for_io": len(self.ready_for_io),
+            "io": len(self.io_tasks),
+        }
+
     def _report(self) -> None:
-        self.reporter.maybe_report(
-            {
-                "pending": len(self.pending),
-                "deferred": len(self.deferred),
-                "staging": len(self.staging_tasks),
-                "streaming": len(self.stream_tasks),
-                "ready_for_io": len(self.ready_for_io),
-                "io": len(self.io_tasks),
-            },
-            self.bytes_staged,
-            self.budget,
-        )
+        self.reporter.maybe_report(self._occupancy(), self.bytes_staged, self.budget)
+
+    def _publish_progress(self) -> None:
+        """Mirror the progress counters as gauges when a session is on, so
+        the persisted artifact (and any live metrics scrape) carries them."""
+        tm = self._tm
+        if tm is None:
+            return
+        p = self.progress
+        tm.metrics.gauge("progress.bytes_staged").set(p.bytes_staged)
+        tm.metrics.gauge("progress.bytes_written").set(p.bytes_written)
+        tm.metrics.gauge("progress.requests_done").set(p.requests_done)
 
     def _stream_eligible(self, req: WriteReq) -> bool:
         """Whether this request goes through the chunk-streaming path:
@@ -555,6 +577,7 @@ class _WritePipeline:
                         outstanding += nbytes - chunk_est
                     chunks += 1
                     self._record_task("stream_chunk", t0, req.path, nbytes)
+                    self.progress.note_staged(nbytes)
                     await queue.put((buf, nbytes))
             finally:
                 await agen.aclose()
@@ -588,6 +611,7 @@ class _WritePipeline:
                 await stream.append(buf)
                 self._record_task("io", t0, req.path, nbytes)
                 total += nbytes
+                self.progress.note_written(nbytes)
                 if not holds_full:
                     budget.credit(nbytes)
                     outstanding -= nbytes
@@ -619,6 +643,12 @@ class _WritePipeline:
                 budget.credit(admitted_cost)
                 admitted_cost = 0
         self.bytes_staged += total
+        # Streamed requests learn their actual size only at stream end:
+        # converge the progress total from the admission estimate.
+        self.progress.adjust_total_bytes(
+            total - stager.get_staging_cost_bytes()
+        )
+        self.progress.note_request_done()
         telemetry.counter_add("scheduler.stream_chunks", chunks)
         if want_digest:
             self.checksums[req.path] = [
@@ -730,13 +760,14 @@ class _WritePipeline:
                 nbytes = memoryview(buf).nbytes
                 self._record_task("stage", t0, req.path, nbytes)
                 self.bytes_staged += nbytes
+                self.progress.note_staged(nbytes, estimate=cost)
                 # Correct the estimate to the real footprint.
                 self.budget.credit(cost)
                 self.budget.debit(nbytes)
                 self.ready_for_io.append((req.path, buf))
             elif task in self.stream_tasks:
-                # Intervals, budget, and byte counts were recorded inside
-                # _stream_one chunk by chunk; only failures remain.
+                # Intervals, budget, byte counts, and progress were recorded
+                # inside _stream_one chunk by chunk; only failures remain.
                 self.stream_tasks.pop(task)
                 task.result()  # propagate failures
             else:
@@ -744,6 +775,10 @@ class _WritePipeline:
                 task.result()  # propagate failures
                 self._record_task("io", t0, path, nbytes)
                 self.budget.credit(nbytes)
+                self.progress.note_written(nbytes)
+                self.progress.note_request_done()
+        if done:
+            self._publish_progress()
 
     async def run_until_staged(self) -> None:
         """Drive the pipeline to the capture point: every *non-deferred*
@@ -751,6 +786,7 @@ class _WritePipeline:
         (immutable device-backed data) then join the queue for the
         background drain."""
         window_t0 = time.monotonic()
+        watchdog_task = self._spawn_watchdog()
         try:
             if self.pending:
                 self._dispatch_staging()
@@ -776,6 +812,7 @@ class _WritePipeline:
             self._shutdown_executor(failed=True)
             raise
         finally:
+            await self._reap_watchdog(watchdog_task)
             self._windows.append((window_t0, time.monotonic()))
         if self.deferred:
             self.pending.extend(self.deferred)
@@ -790,6 +827,7 @@ class _WritePipeline:
         # billed during the stall must not deflate the apparent drain
         # rate), while pipeline_stats covers every window for sync takes.
         drain_t0 = time.monotonic()
+        watchdog_task = self._spawn_watchdog()
         try:
             if self.pending or self.staging_tasks:
                 self._dispatch_staging()
@@ -865,8 +903,10 @@ class _WritePipeline:
         except BaseException:
             # Error path: cancel queued staging/hash thunks so they don't
             # run against a torn-down pipeline.
+            await self._reap_watchdog(watchdog_task)
             self._shutdown_executor(failed=True)
             raise
+        await self._reap_watchdog(watchdog_task)
         self._shutdown_executor()
 
         drain_window = (drain_t0, time.monotonic())
@@ -917,6 +957,33 @@ class _WritePipeline:
                 efficiency * 100,
                 ps["idle_s"],
             )
+
+    def _spawn_watchdog(self) -> Optional[asyncio.Task]:
+        """Opt-in liveness: one structured warning per stall (no byte
+        progress for TORCHSNAPSHOT_TPU_STALL_WARN_S seconds). Armed around
+        BOTH wait loops — a sync take's streams complete inside
+        run_until_staged, so covering only the drain would leave exactly
+        the hung-stream case unwatched there. The caller retains the task
+        and reaps it (``_reap_watchdog``) on every exit path."""
+        warn_s = knobs.get_stall_warn_s()
+        if warn_s <= 0:
+            return None
+        watchdog = telemetry.StallWatchdog(
+            self.progress,
+            warn_s,
+            occupancy=self._occupancy,
+            rank=self.rank,
+            on_fire=lambda: telemetry.counter_add(
+                "scheduler.stall_warnings", 1
+            ),
+        )
+        return asyncio.ensure_future(watchdog.run())
+
+    @staticmethod
+    async def _reap_watchdog(task: Optional[asyncio.Task]) -> None:
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
 
     def _mark_staged(self) -> None:
         if (
@@ -972,6 +1039,42 @@ class PendingIOWork:
         staging + drain) — what a sync take should report, since its
         staging completes before the drain loop ever runs."""
         return dict(self._pipeline.pipeline_stats)
+
+    @property
+    def progress(self) -> "telemetry.ProgressTracker":
+        """The pipeline's live progress counters (monotonic; safe to read
+        from any thread while the drain runs)."""
+        return self._pipeline.progress
+
+    def progress_snapshot(self) -> Dict[str, float]:
+        """Counters + derived rates/ETA (see ProgressTracker.snapshot)."""
+        return self._pipeline.progress.snapshot()
+
+    def telemetry_io_summary(self) -> Dict[str, object]:
+        """Everything the persisted telemetry artifact needs from this
+        pipeline: overlap stats, merged stream intervals + accounting
+        windows (monotonic seconds; the artifact builder rebases them to
+        the unix epoch), and the byte/request totals. Meaningful once the
+        pipeline has completed."""
+        p = self._pipeline
+        counters = p.progress.counters()
+        return {
+            "pipeline_stats_s": dict(p.pipeline_stats),
+            "drain_stats_s": dict(p.drain_stats),
+            "bytes": {
+                "staged": p.bytes_staged,
+                "written": counters["bytes_written"],
+                "total": counters["bytes_total"],
+                "deduped": p.bytes_deduped,
+            },
+            "requests": {
+                "done": counters["requests_done"],
+                "total": counters["requests_total"],
+            },
+            "windows": list(p._windows),
+            "stage_intervals": _merge_intervals(p._stage_intervals),
+            "io_intervals": _merge_intervals(p._io_intervals),
+        }
 
 
 async def execute_write_reqs(

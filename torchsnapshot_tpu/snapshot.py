@@ -107,11 +107,17 @@ def _begin_telemetry(
     explicit: Optional["telemetry.Telemetry"],
 ) -> Tuple[Optional["telemetry.Telemetry"], Optional["telemetry.Telemetry"]]:
     """Start a telemetry session for one take/restore: an explicit
-    ``_telemetry=`` object wins, else ``TORCHSNAPSHOT_TPU_TRACE`` creates
-    one, else no session (and the instrumented paths cost one None-check).
-    Returns (session, previously-active session)."""
+    ``_telemetry=`` object wins, else ``TORCHSNAPSHOT_TPU_TRACE`` or the
+    (default-on) persisted-artifact knob creates one — the artifact needs
+    the metrics registry and byte counters, so auditable-by-default
+    checkpoints imply a session per op. Only with artifacts explicitly
+    disabled (and no trace/_telemetry) does the op run with telemetry fully
+    off, where the instrumented paths cost one None-check. Returns
+    (session, previously-active session)."""
     tm = explicit
-    if tm is None and knobs.get_trace_path():
+    if tm is None and (
+        knobs.get_trace_path() or knobs.is_telemetry_artifacts_enabled()
+    ):
         tm = telemetry.Telemetry()
     prev = telemetry.activate(tm) if tm is not None else None
     return tm, prev
@@ -131,6 +137,10 @@ def _finish_telemetry(
         return
     tm.rank = rank
     telemetry.deactivate(tm, prev)
+    if tm.buffer.dropped:
+        # Make capacity truncation visible in the metrics dump (and thus
+        # the persisted artifact) — never a silently partial trace.
+        tm.metrics.counter("telemetry.spans_dropped").add(tm.buffer.dropped)
     Snapshot.last_telemetry = tm
     trace_path = knobs.get_trace_path()
     if trace_path:
@@ -141,6 +151,64 @@ def _finish_telemetry(
             logger.warning(
                 "failed to write telemetry trace to %s", path, exc_info=True
             )
+
+
+# Artifact BUILD failures also log once per process (the write path has its
+# own once-guard in storage_plugin.write_telemetry_artifact).
+_artifact_build_warned = False
+
+
+def _persist_op_artifact(
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+    rank: int,
+    world_size: int,
+    op: str,
+    tm: Optional["telemetry.Telemetry"],
+    phase_spans=None,
+    io_summary: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Persist this rank's telemetry artifact into the snapshot, fail-open.
+
+    Called pre-commit (take/async_take: after the drain, before the commit
+    barrier; restore: before the post-load barrier) so a committed snapshot
+    always carries every rank's artifact. Any failure logs once and never
+    fails or delays the operation."""
+    global _artifact_build_warned
+    if not knobs.is_telemetry_artifacts_enabled():
+        return
+    from .storage_plugin import write_telemetry_artifact
+    from .telemetry import artifact as telemetry_artifact
+
+    try:
+        payload = telemetry_artifact.dumps_artifact(
+            telemetry_artifact.build_artifact(
+                op=op,
+                rank=rank,
+                world_size=world_size,
+                tm=tm,
+                phase_spans=phase_spans,
+                io_summary=io_summary,
+            )
+        )
+    except Exception:  # noqa: BLE001 - diagnostics must not fail the op
+        if not _artifact_build_warned:
+            _artifact_build_warned = True
+            logger.warning(
+                "failed to build telemetry artifact for %s (snapshot "
+                "unaffected)", op, exc_info=True,
+            )
+        else:
+            logger.debug(
+                "failed to build telemetry artifact for %s", op, exc_info=True
+            )
+        return
+    write_telemetry_artifact(
+        storage,
+        event_loop,
+        telemetry_artifact.artifact_path(rank, op),
+        payload,
+    )
 
 
 class Snapshot:
@@ -207,6 +275,21 @@ class Snapshot:
                 pending_io_work.sync_complete(event_loop)
                 LAST_SYNC_DRAIN_STATS.clear()
                 LAST_SYNC_DRAIN_STATS.update(pending_io_work.pipeline_stats)
+                # Per-rank telemetry artifact, written pre-barrier so the
+                # committed snapshot carries every rank's record of how it
+                # was written. Fail-open by contract.
+                _persist_op_artifact(
+                    storage,
+                    event_loop,
+                    rank=coord.get_rank(),
+                    world_size=coord.get_world_size(),
+                    op="take",
+                    tm=tm,
+                    phase_spans=plan.phase_tracker.spans
+                    if plan.phase_tracker
+                    else None,
+                    io_summary=pending_io_work.telemetry_io_summary(),
+                )
                 # Commit metadata only after ALL ranks finished writing data.
                 with telemetry.span("take.commit", cat="take"):
                     coord.barrier()
@@ -284,6 +367,7 @@ class Snapshot:
             event_loop=event_loop,
             tm=tm,
             tm_prev=tm_prev,
+            phase_spans=plan.phase_tracker.spans if plan.phase_tracker else None,
         )
 
     @classmethod
@@ -725,6 +809,19 @@ class Snapshot:
                             event_loop=event_loop,
                             pools=pools,
                         )
+            # Restore telemetry artifact (.telemetry/restore_rank_<k>.json):
+            # the restore-side record — metrics dump (bytes read per
+            # plugin), per-stateful load spans — written through the same
+            # plugin, fail-open (a read-only snapshot store just logs once).
+            _persist_op_artifact(
+                storage,
+                event_loop,
+                rank=rank,
+                world_size=coord.get_world_size(),
+                op="restore",
+                tm=tm,
+                phase_spans=tm.spans(cat="restore") if tm is not None else None,
+            )
             # Single post-load barrier: no rank observes restore() as
             # complete (and e.g. deletes/overwrites the snapshot, or
             # reports readiness) while a peer is still reading storage.
@@ -1593,6 +1690,7 @@ class PendingSnapshot:
         event_loop: asyncio.AbstractEventLoop,
         tm: Optional["telemetry.Telemetry"] = None,
         tm_prev: Optional["telemetry.Telemetry"] = None,
+        phase_spans=None,
     ) -> None:
         self.path = path
         self._coord = coord
@@ -1603,6 +1701,10 @@ class PendingSnapshot:
         # in the same trace as the stall's planning phases.
         self._tm = tm
         self._tm_prev = tm_prev
+        # The take's phase spans (final by construction time: _take_impl has
+        # returned), persisted into the snapshot's telemetry artifact by the
+        # background drain.
+        self._phase_spans = phase_spans
         PendingSnapshot._seq += 1
         self._barrier_id = f"async_commit/{PendingSnapshot._seq}/{path}"
         self._exc: Optional[BaseException] = None
@@ -1632,6 +1734,18 @@ class PendingSnapshot:
         )
         try:
             pending_io_work.sync_complete(event_loop)
+            # Pre-barrier, like the checksum sidecars: every committed
+            # snapshot carries every rank's artifact. Fail-open.
+            _persist_op_artifact(
+                storage,
+                event_loop,
+                rank=rank,
+                world_size=self._coord.get_world_size(),
+                op="async_take",
+                tm=self._tm,
+                phase_spans=self._phase_spans,
+                io_summary=pending_io_work.telemetry_io_summary(),
+            )
             barrier.arrive()
             if rank == 0:
                 Snapshot._write_snapshot_metadata(self._metadata, storage, event_loop)
@@ -1666,6 +1780,17 @@ class PendingSnapshot:
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def progress(self) -> Dict[str, float]:
+        """Live progress of the background drain, safe to call from the
+        training thread at any time: strictly nondecreasing
+        ``bytes_staged`` / ``bytes_written`` / ``requests_done`` counters
+        fed by the scheduler (``bytes_written`` ends equal to the take's
+        total payload bytes), plus ``bytes_total`` / ``requests_total``,
+        instantaneous and EWMA write rates over the polling window, and an
+        ``eta_s`` estimate (None until a rate is established, 0.0 when all
+        bytes are written). See ``telemetry.ProgressTracker.snapshot``."""
+        return self._pending_io_work.progress_snapshot()
 
     @property
     def drain_stats(self) -> Dict[str, float]:

@@ -3,14 +3,67 @@
 Builtin protocols: ``fs://`` (and bare paths), ``memory://``, ``gs://``,
 ``s3://``. Third-party plugins register via the ``torchsnapshot_tpu.storage_plugins``
 entry-point group, mirroring the reference's ``storage_plugins`` group.
+
+Also home of the telemetry-artifact write path
+(:func:`write_telemetry_artifact`): artifacts persist through the
+snapshot's own plugin — fs/gs/s3/memory alike — and the write is fail-open
+by contract (diagnostics must never fail or delay a checkpoint commit).
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Optional
 
-from .io_types import StoragePlugin
+from . import telemetry
+from .io_types import StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+# Artifact persistence failures log loudly ONCE per process (with the
+# traceback) and quietly thereafter: a wedged diagnostics path must not spam
+# a warning per rank-file per checkpoint interval.
+_artifact_write_warned = False
+
+
+def write_telemetry_artifact(
+    storage: StoragePlugin,
+    event_loop: asyncio.AbstractEventLoop,
+    path: str,
+    payload: bytes,
+) -> bool:
+    """Fail-open write of one telemetry artifact through ``storage``.
+
+    Returns True when the artifact landed. Any failure — plugin error,
+    read-only backend, closed loop — is logged (once per process with the
+    traceback, then at debug) and swallowed: telemetry persistence must
+    never fail or delay the snapshot commit it rides alongside.
+    """
+    global _artifact_write_warned
+    try:
+        with telemetry.span(
+            "telemetry.artifact_write",
+            cat="telemetry",
+            path=path,
+            nbytes=len(payload),
+        ):
+            storage.sync_write(WriteIO(path=path, buf=payload), event_loop)
+        return True
+    except Exception:  # noqa: BLE001 - fail-open by contract
+        if not _artifact_write_warned:
+            _artifact_write_warned = True
+            logger.warning(
+                "failed to persist telemetry artifact %s (snapshot commit "
+                "unaffected; further artifact-write failures log at DEBUG)",
+                path,
+                exc_info=True,
+            )
+        else:
+            logger.debug(
+                "failed to persist telemetry artifact %s", path, exc_info=True
+            )
+        return False
 
 
 def url_to_storage_plugin(url_path: str) -> StoragePlugin:

@@ -19,11 +19,20 @@ Enabling it (pick one):
 - ``python -m torchsnapshot_tpu trace <snapshot>`` — traced read of an
   existing snapshot, trace written to ``--output``.
 
+Beyond the in-process session, every take/async_take/restore also persists
+a compact per-rank artifact at ``.telemetry/rank_<k>.json`` inside the
+snapshot (``artifact.py``; knob ``TORCHSNAPSHOT_TPU_TELEMETRY_ARTIFACTS``,
+on by default, fail-open), merged across ranks by ``aggregate.py`` and the
+``stats``/``compare`` CLI subcommands; ``progress.py`` holds the live
+progress counters behind ``PendingSnapshot.progress()`` and the opt-in
+stall watchdog (``TORCHSNAPSHOT_TPU_STALL_WARN_S``).
+
 When nothing is active, :func:`span` returns a shared no-op singleton and
 the metric helpers return after one ``is None`` check — the instrumented
 hot paths allocate nothing.
 
-See ``docs/observability.md`` for the span/metric catalog.
+See ``docs/observability.md`` for the span/metric catalog and the artifact
+schema.
 """
 
 from __future__ import annotations
@@ -48,8 +57,14 @@ from .export import (
     write_chrome_trace,
 )
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .progress import ProgressTracker, StallWatchdog
+from . import aggregate, artifact
 
 __all__ = [
+    "aggregate",
+    "artifact",
+    "ProgressTracker",
+    "StallWatchdog",
     "Telemetry",
     "Span",
     "TraceBuffer",

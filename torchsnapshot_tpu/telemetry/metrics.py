@@ -13,6 +13,7 @@ two event loops are the norm, not the exception).
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Dict, Union
 
@@ -59,11 +60,24 @@ class Gauge:
                 self.value = v
 
 
-class Histogram:
-    """Count/sum/min/max summary (no buckets: the trace itself carries the
-    full distribution as spans; the histogram is the cheap aggregate)."""
+# Fixed log-bucket resolution: 4 buckets per power of 2 (~19% relative
+# width), scale-free — the same buckets serve seconds and bytes. The bucket
+# map is sparse (a dict keyed by index), so memory tracks the observed
+# dynamic range, not a preallocated axis.
+_BUCKETS_PER_OCTAVE = 4
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+class Histogram:
+    """Count/sum/min/max summary plus fixed log-bucket percentiles.
+
+    The buckets are geometric (``_BUCKETS_PER_OCTAVE`` per power of 2), so a
+    percentile is exact to one bucket's relative width (~19%) at any scale —
+    good enough to tell a p99 storage write from the median without keeping
+    the full distribution. The trace still carries every sample as a span;
+    the histogram is the cheap aggregate that survives in the persisted
+    artifact."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_buckets", "_nonpos", "_lock")
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -71,6 +85,8 @@ class Histogram:
         self.sum: float = 0.0
         self.min: float = float("inf")
         self.max: float = 0.0
+        self._buckets: Dict[int, int] = {}
+        self._nonpos = 0  # v <= 0: no log bucket; reported as 0.0
         self._lock = threading.Lock()
 
     def observe(self, v: Union[int, float]) -> None:
@@ -81,10 +97,33 @@ class Histogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+            if v > 0:
+                idx = math.floor(math.log2(v) * _BUCKETS_PER_OCTAVE)
+                self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            else:
+                self._nonpos += 1
 
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile (q in [0, 100]) from the log buckets:
+        the upper edge of the bucket where the cumulative count crosses
+        q% of observations, clamped into [min, max]."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = max(1.0, (q / 100.0) * self.count)
+            cum = self._nonpos
+            if cum >= target:
+                return min(max(0.0, self.min), self.max)
+            for idx in sorted(self._buckets):
+                cum += self._buckets[idx]
+                if cum >= target:
+                    upper = 2.0 ** ((idx + 1) / _BUCKETS_PER_OCTAVE)
+                    return min(max(upper, self.min), self.max)
+            return self.max
 
 
 class MetricsRegistry:
@@ -118,7 +157,7 @@ class MetricsRegistry:
     def as_dict(self) -> Dict[str, Union[int, float]]:
         """Flat {name: value} snapshot. Counters/gauges export one entry;
         gauges with a distinct max add ``<name>.max``; histograms export
-        ``<name>.{count,sum,min,max,mean}``."""
+        ``<name>.{count,sum,min,max,mean,p50,p95,p99}``."""
         out: Dict[str, Union[int, float]] = {}
         with self._lock:
             counters = list(self._counters.values())
@@ -136,4 +175,7 @@ class MetricsRegistry:
             out[f"{h.name}.min"] = h.min if h.count else 0.0
             out[f"{h.name}.max"] = h.max
             out[f"{h.name}.mean"] = h.mean
+            out[f"{h.name}.p50"] = h.percentile(50)
+            out[f"{h.name}.p95"] = h.percentile(95)
+            out[f"{h.name}.p99"] = h.percentile(99)
         return out

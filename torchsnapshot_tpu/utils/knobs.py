@@ -317,6 +317,52 @@ def override_checksums(enabled: bool):
 
 
 _ENV_TRACE = "TORCHSNAPSHOT_TPU_TRACE"
+_ENV_TELEMETRY_ARTIFACTS = "TORCHSNAPSHOT_TPU_TELEMETRY_ARTIFACTS"
+_ENV_STALL_WARN_S = "TORCHSNAPSHOT_TPU_STALL_WARN_S"
+
+
+def is_telemetry_artifacts_enabled() -> bool:
+    """Persist a compact per-rank telemetry artifact
+    (``.telemetry/rank_<k>.json``: phase durations, drain/pipeline interval
+    stats, byte counters, metrics dump, environment fingerprint) inside
+    every snapshot, through the snapshot's own storage plugin, before the
+    commit barrier — so committed snapshots are auditable after the fact
+    (``python -m torchsnapshot_tpu stats <snapshot>``). On by default;
+    artifact persistence is fail-open (a write failure logs once and never
+    fails the checkpoint). Disabling also restores the fully-off telemetry
+    hot path for untraced takes (no session, no span allocation)."""
+    return os.environ.get(_ENV_TELEMETRY_ARTIFACTS, "1") not in (
+        "0",
+        "false",
+        "False",
+    )
+
+
+def override_telemetry_artifacts(enabled: bool):
+    return _override_env(_ENV_TELEMETRY_ARTIFACTS, "1" if enabled else "0")
+
+
+def get_stall_warn_s() -> float:
+    """Opt-in drain stall watchdog: when set to a positive number of
+    seconds, the write pipeline runs a watchdog task that logs ONE
+    structured warning (with the stuck stage and pipeline occupancy) each
+    time the drain makes no byte progress for this long, re-arming when
+    progress resumes. 0/unset disables the watchdog entirely."""
+    val = os.environ.get(_ENV_STALL_WARN_S)
+    return float(val) if val else 0.0
+
+
+def override_stall_warn_s(value: float):
+    return _override_env(_ENV_STALL_WARN_S, str(value))
+
+
+def env_fingerprint() -> dict:
+    """Every ``TORCHSNAPSHOT_TPU_*`` env var currently set, verbatim — the
+    knob half of the persisted artifact's environment fingerprint. Reading
+    the raw env (rather than each getter) records exactly what the operator
+    pinned, including values the resolvers would normalize."""
+    prefix = _ENV_TRACE[: _ENV_TRACE.index("TRACE")]  # "TORCHSNAPSHOT_TPU_"
+    return {k: v for k, v in sorted(os.environ.items()) if k.startswith(prefix)}
 
 
 def get_trace_path() -> Optional[str]:
