@@ -469,6 +469,18 @@ def main() -> None:
                         "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
                         "restore_bit_exact": ok,
                         "telemetry": telemetry_summary,
+                        # Environment fingerprint: every TORCHSNAPSHOT_TPU_*
+                        # knob in effect, plus an explicit record that fault
+                        # injection was OFF — a benchmark run with the fault
+                        # knob set would measure the injector, not the
+                        # library, so its absence is part of the result's
+                        # identity.
+                        "env": {
+                            "knobs": _knobs.env_fingerprint(),
+                            "fault_injection": (
+                                _knobs.get_faults_spec() or "disabled"
+                            ),
+                        },
                         "baseline": (
                             "reference-style async_take must capture to host RAM "
                             "before returning; its stall >= one full D2H transfer "

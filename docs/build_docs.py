@@ -31,6 +31,7 @@ DOC_SOURCES = [
     "docs/utilities.md",
     "docs/observability.md",
     "docs/performance.md",
+    "docs/robustness.md",
     "docs/static-analysis.md",
 ]
 

@@ -9,6 +9,7 @@ what fits, host-capturing the rest — instead of raising, while staying
 donation-safe and producing a byte-identical snapshot layout.
 """
 
+import importlib.util
 import logging
 import os
 
@@ -179,6 +180,10 @@ def test_non_oom_fork_error_still_raises(tmp_path, monkeypatch) -> None:
         Snapshot.async_take(str(tmp_path / "ckpt"), {"s": StateDict(w=x)})
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("zstandard") is None,
+    reason="zstandard not installed (optional dependency)",
+)
 def test_degraded_capture_composes_with_compressed_slabs(tmp_path, caplog) -> None:
     """HBM-degraded host captures still join member-framed compressed slabs
     (their stagers hold private host buffers and pack like any host member)

@@ -77,9 +77,15 @@ def _device_arrays(n=12, dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
 
+    # Generate in int32 and convert: narrow integer dtypes (int8) overflow
+    # past ~5 arrays, and numpy 2.x makes out-of-range arange a hard
+    # OverflowError instead of wrapping. The byte-identity tests only need
+    # distinct deterministic bit patterns, which the wrap preserves.
     return {
         f"p{i}": jax.device_put(
-            jnp.arange(i * 24, (i + 1) * 24, dtype=jnp.dtype(dtype)).reshape(6, 4)
+            jnp.arange(i * 24, (i + 1) * 24, dtype=jnp.int32)
+            .astype(jnp.dtype(dtype))
+            .reshape(6, 4)
         )
         for i in range(n)
     }

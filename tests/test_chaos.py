@@ -1,0 +1,739 @@
+"""Seeded chaos harness: deterministic fault schedules against the
+crash-consistency contract.
+
+Every robustness claim the library makes is asserted here under INJECTED
+failure, via ``TORCHSNAPSHOT_TPU_FAULTS`` (``faults.py``):
+
+- **atomic commit** — a torn take never exposes ``.snapshot_metadata``; a
+  previously committed snapshot restores bit-exact afterwards;
+- **abort-leaves-nothing streams** — aborted/mid-failed write streams leave
+  no visible object (and on fs, their temp files are unlinked);
+- **structured abort** — failures surface as ``CheckpointAbortedError``
+  naming the failing rank and phase, on every rank, within the barrier
+  timeout; the scheduler's memory budget is fully credited back;
+- **collective-progress retry** — injected transient storms are retried
+  through the shared cloud_retry machinery and the take still commits;
+- **gc** — after a crash, ``Snapshot.gc`` reclaims exactly the debris and a
+  retake into the same parent succeeds.
+
+The fast subset below runs in tier-1; the ``slow``-marked matrix replays
+20+ distinct seeded schedules across fs / memory / fake-gcs backends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import CheckpointAbortedError, Snapshot, StateDict
+from torchsnapshot_tpu.faults import (
+    KILL_EXIT_CODE,
+    FaultSpecError,
+    FaultyStoragePlugin,
+    parse_fault_spec,
+)
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugin import _resolve_storage_plugin
+from torchsnapshot_tpu.test_utils import run_with_processes
+from torchsnapshot_tpu.utils import knobs
+
+
+# ---------------------------------------------------------------------------
+# Backend plumbing. Inspection (listing, metadata probes) always goes through
+# a PRISTINE plugin (_resolve_storage_plugin: no fault wrapper), so the
+# harness's own assertions can't be faulted.
+# ---------------------------------------------------------------------------
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _list(url: str):
+    plugin = _resolve_storage_plugin(url)
+    try:
+        return _run(plugin.list_prefix(""))
+    finally:
+        _run(plugin.close())
+
+
+def _backend_url(backend: str, tmp_path, request) -> str:
+    if backend == "fs":
+        return str(tmp_path / "chaos")
+    if backend == "memory":
+        # Unique shared-root per test: memory:// roots are process-cached.
+        return f"memory://chaos-{request.node.name}"
+    if backend == "gcs":
+        return "gs://bucket/chaos"
+    raise AssertionError(backend)
+
+
+@pytest.fixture
+def gcs_backend(monkeypatch):
+    """Fake google.cloud.storage SDK (shared with the GCS plugin tests)."""
+    from test_gcs_storage_plugin import _install_fake_gcs
+
+    blobs: dict = {}
+    _install_fake_gcs(monkeypatch, blobs, {})
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
+
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 0.001)
+    return blobs
+
+
+@pytest.fixture
+def any_backend(request, tmp_path, monkeypatch):
+    backend = request.param
+    if backend == "gcs":
+        request.getfixturevalue("gcs_backend")
+    return _backend_url(backend, tmp_path, request)
+
+
+def _state(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {
+        "s": StateDict(
+            w=rng.standard_normal(512).astype(np.float32),
+            b=np.arange(64, dtype=np.int64) + seed,
+            step=seed,
+        )
+    }
+
+
+def _assert_restores_bit_exact(url: str, seed: int = 0) -> None:
+    src = _state(seed)["s"]
+    tgt = {
+        "s": StateDict(
+            w=np.zeros(512, np.float32), b=np.zeros(64, np.int64), step=-1
+        )
+    }
+    Snapshot(url).restore(tgt)
+    assert np.array_equal(
+        tgt["s"]["w"].view(np.uint8), np.asarray(src["w"]).view(np.uint8)
+    )
+    assert np.array_equal(tgt["s"]["b"], src["b"])
+    assert tgt["s"]["step"] == src["step"]
+
+
+def _chaos_round(parent_url: str, spec: str, expect_abort: bool = True):
+    """One chaos scenario: commit ``prev``, run a faulted take at ``cur``,
+    then assert the full crash-consistency invariant bundle."""
+    sep = "" if parent_url.endswith("/") else "/"
+    prev = f"{parent_url}{sep}prev"
+    cur = f"{parent_url}{sep}cur"
+    Snapshot.take(prev, _state(seed=1))
+    assert Snapshot(prev).verify() == {}
+    # One restore BEFORE the baseline listing: restores persist their own
+    # telemetry artifact into the snapshot (same filename every time), so
+    # the post-gc listing comparison below must include it.
+    _assert_restores_bit_exact(prev, seed=1)
+    committed_before = set(_list(parent_url))
+
+    aborted = None
+    with knobs.override_faults(spec):
+        try:
+            Snapshot.take(cur, _state(seed=2))
+        except CheckpointAbortedError as e:
+            aborted = e
+
+    if expect_abort:
+        assert aborted is not None, f"spec {spec!r} injected nothing"
+        assert aborted.phase in ("write", "commit"), aborted
+        # The torn take never exposes a commit marker...
+        assert "cur/.snapshot_metadata" not in _list(parent_url)
+        # ...and the prior snapshot is untouched, bit for bit.
+        assert Snapshot(prev).verify() == {}
+        _assert_restores_bit_exact(prev, seed=1)
+        # gc reclaims every byte of debris: afterwards the parent holds
+        # exactly the committed snapshot's files. memory:// roots are
+        # disjoint per-URL namespaces (no parent listing), so gc runs per
+        # snapshot there; hierarchical backends (fs, gcs) gc the parent.
+        if parent_url.startswith("memory://"):
+            report = Snapshot.gc(cur, dry_run=False)
+            assert report["committed"] == [], report
+            assert _list(cur) == [], _list(cur)
+            report = Snapshot.gc(prev, dry_run=False)
+            assert report["committed"] == [""], report
+            assert report["remove"] == [], report
+        else:
+            report = Snapshot.gc(parent_url, dry_run=False)
+            assert "prev" in report["committed"], report
+        after = set(_list(parent_url))
+        assert after == committed_before, (
+            f"gc left debris or ate committed files: "
+            f"{after ^ committed_before}"
+        )
+        # A retake into the same parent (faults off) commits cleanly.
+        snap = Snapshot.take(cur, _state(seed=2))
+        assert snap.verify() == {}
+        _assert_restores_bit_exact(cur, seed=2)
+    else:
+        # Resilience schedule (e.g. transient storm): the take must have
+        # SUCCEEDED through the retry machinery.
+        assert aborted is None, aborted
+        assert Snapshot(cur).verify() == {}
+        _assert_restores_bit_exact(cur, seed=2)
+    return aborted
+
+
+# ---------------------------------------------------------------------------
+# Spec-parser unit tests (fast)
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parses_full_grammar() -> None:
+    plan = parse_fault_spec(
+        "seed=42;backoff=0.01;window=3.5;"
+        "op=write,at=2,kind=torn,bytes=128;"
+        "op=append,kind=transient,times=3,rank=1;"
+        "op=read,p=0.25,kind=stall,secs=0.5,path=.snapshot_metadata"
+    )
+    assert plan.seed == 42 and plan.backoff_s == 0.01 and plan.window_s == 3.5
+    torn, transient, stall = plan.rules
+    assert (torn.op, torn.at, torn.kind, torn.bytes) == ("write", 2, "torn", 128)
+    assert (transient.times, transient.rank) == (3, 1)
+    assert (stall.p, stall.secs, stall.path) == (0.25, 0.5, ".snapshot_metadata")
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "op=write",  # no kind
+        "op=write,kind=banana",
+        "op=teleport,kind=fail",
+        "op=write,kind=fail,whatever=1",
+        "op=read,kind=torn,bytes=4",  # torn is write/append-only
+        "op=write,kind=fail,at=x",
+        "notakeyvalue",
+        "seed=1,window=bad",
+    ],
+)
+def test_fault_spec_rejects_malformed(bad: str) -> None:
+    with pytest.raises(FaultSpecError):
+        parse_fault_spec(bad)
+
+
+def test_fault_schedule_is_deterministic() -> None:
+    """Same seed + op sequence => identical injection schedule."""
+
+    def draw(seed: int):
+        plan = parse_fault_spec(f"seed={seed};op=write,p=0.5,kind=fail,times=100")
+        plugin = FaultyStoragePlugin(
+            _resolve_storage_plugin("memory://det"), plan
+        )
+        hits = []
+        for i in range(64):
+            hits.append(plugin._next_action("write", f"obj{i}") is not None)
+        return hits
+
+    a, b, c = draw(7), draw(7), draw(8)
+    assert a == b
+    assert a != c  # different seed, different schedule
+    assert any(a) and not all(a)  # an actual mixture
+
+
+def test_unfaulted_ops_pass_through(tmp_path) -> None:
+    """A spec matching nothing is fully transparent — writes, reads,
+    streams, listing all behave identically to the bare plugin."""
+    plugin = FaultyStoragePlugin(
+        _resolve_storage_plugin(str(tmp_path)),
+        parse_fault_spec("op=delete,at=999,kind=fail"),
+    )
+    assert plugin.supports_streaming and plugin.scales_io_with_local_world
+
+    async def roundtrip():
+        await plugin.write(WriteIO(path="a/b", buf=b"hello"))
+        stream = await plugin.write_stream("a/c")
+        await stream.append(b"wor")
+        await stream.append(b"ld")
+        await stream.commit()
+        read_io = ReadIO(path="a/c")
+        await plugin.read(read_io)
+        assert read_io.buf.getvalue() == b"world"
+        assert await plugin.list_prefix("") == ["a/b", "a/c"]
+        await plugin.close()
+
+    _run(roundtrip())
+
+
+def test_retry_backoff_clamped_to_progress_window() -> None:
+    """The give-up deadline is honored promptly: a huge exponential backoff
+    is clamped to the collective-progress window's remaining time, and
+    out_of_time is re-checked after the sleep — the loop can no longer
+    overshoot the window by a full backoff period."""
+    import time
+
+    from torchsnapshot_tpu.storage_plugins.cloud_retry import (
+        CollectiveProgress,
+        retry_transient,
+    )
+
+    progress = CollectiveProgress(window_s=0.3)
+    attempts = []
+
+    async def always_transient():
+        attempts.append(time.monotonic())
+        raise ConnectionError("flaky")
+
+    async def drive():
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            # base_backoff_s=30: unclamped, the FIRST sleep alone would be
+            # 15-45 s; clamped, the loop gives up within ~window.
+            await retry_transient(
+                always_transient,
+                lambda e: isinstance(e, ConnectionError),
+                progress,
+                "clamptest",
+                base_backoff_s=30.0,
+            )
+        return time.monotonic() - t0
+
+    elapsed = _run(drive())
+    assert elapsed < 2.0, f"gave up after {elapsed:.2f}s (window 0.3s)"
+    assert len(attempts) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Fast tier-1 chaos subset
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "any_backend", ["fs", "memory", "gcs"], indirect=True
+)
+def test_chaos_torn_write_fast(any_backend) -> None:
+    _chaos_round(any_backend, "op=write,kind=torn,bytes=64,path=0/s")
+
+
+@pytest.mark.parametrize("any_backend", ["fs", "memory"], indirect=True)
+def test_chaos_transient_storm_commits_fast(any_backend) -> None:
+    _chaos_round(
+        any_backend,
+        "backoff=0.005;op=write,kind=transient,times=4",
+        expect_abort=False,
+    )
+
+
+def test_chaos_permanent_failure_names_rank_and_phase(tmp_path) -> None:
+    e = _chaos_round(str(tmp_path), "op=write,kind=fail,path=0/s")
+    assert e.rank == 0 and e.phase == "write"
+    assert "injected" in str(e) and "failed" in str(e)
+
+
+def test_chaos_commit_phase_failure(tmp_path) -> None:
+    """Failing the metadata write itself: the abort names the commit phase
+    and no partial metadata object is visible (fs writes are atomic)."""
+    e = _chaos_round(
+        str(tmp_path), "op=write,kind=fail,path=.snapshot_metadata"
+    )
+    assert e.phase == "commit", e
+
+
+def test_chaos_torn_fs_stream_abort_unlinks_temp(tmp_path) -> None:
+    """A torn APPEND mid-stream: the scheduler aborts the storage stream and
+    the fs plugin's abort must unlink its temp file (satellite: error paths
+    of write_stream leave no partial files behind)."""
+    url = str(tmp_path / "t")
+    big = np.random.default_rng(0).standard_normal(2**16).astype(np.float32)
+    with knobs.override_stream_writes(True), knobs.override_stream_chunk_bytes(
+        4096
+    ):
+        with knobs.override_faults("op=append,at=2,kind=torn,bytes=100"):
+            with pytest.raises(CheckpointAbortedError):
+                Snapshot.take(url, {"s": StateDict(w=big)})
+    assert glob.glob(str(tmp_path / "t" / "**" / "*.tmp.*"), recursive=True) == []
+    assert not os.path.exists(os.path.join(url, ".snapshot_metadata"))
+
+
+def test_chaos_budget_credited_on_abort(tmp_path) -> None:
+    """Scheduler-level: a mid-pipeline failure cancels in-flight work and
+    credits every budget debit back (the balanced-budget invariant)."""
+    from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+    from torchsnapshot_tpu.scheduler import execute_write_reqs
+
+    plugin = FaultyStoragePlugin(
+        _resolve_storage_plugin(str(tmp_path)),
+        parse_fault_spec("op=write,at=1,kind=fail"),
+    )
+    arrays = {
+        f"k{i}": np.random.default_rng(i).standard_normal(1024).astype(
+            np.float32
+        )
+        for i in range(6)
+    }
+    reqs = []
+    for name, arr in arrays.items():
+        _entry, wreqs = ArrayIOPreparer.prepare_write(name, arr)
+        reqs.extend(wreqs)
+
+    async def run():
+        pending = await execute_write_reqs(
+            reqs,
+            plugin,
+            memory_budget_bytes=1 << 20,
+            rank=0,
+        )
+        with pytest.raises(Exception, match="injected"):
+            await pending.complete()
+        assert pending.budget_balanced
+
+    _run(run())
+
+
+def test_chaos_async_take_wait_raises_structured_abort(tmp_path) -> None:
+    url = str(tmp_path / "a")
+    with knobs.override_faults("op=write,kind=fail,path=0/s"):
+        pending = Snapshot.async_take(url, _state())
+        with pytest.raises(CheckpointAbortedError) as exc_info:
+            pending.wait()
+    assert exc_info.value.rank == 0
+    assert exc_info.value.phase == "write"
+    assert not os.path.exists(os.path.join(url, ".snapshot_metadata"))
+
+
+def test_chaos_stall_drives_watchdog(tmp_path, caplog) -> None:
+    """A latency stall longer than the watchdog threshold produces the
+    structured stall warning (and the take still commits)."""
+    url = str(tmp_path / "s")
+    with knobs.override_stall_warn_s(0.2):
+        with knobs.override_faults("op=write,kind=stall,secs=1.0,path=0/s"):
+            with caplog.at_level("WARNING"):
+                Snapshot.take(url, _state())
+    assert any(
+        "no byte progress" in r.message or "stall" in r.message.lower()
+        for r in caplog.records
+    ), [r.message for r in caplog.records]
+    assert Snapshot(url).verify() == {}
+
+
+def test_chaos_kill_mid_write_subprocess(tmp_path) -> None:
+    """Real process death at an injected crash point: the child dies with
+    the fault exit code, the torn take exposes no metadata, gc reclaims the
+    debris, and a retake into the same parent succeeds."""
+    parent = str(tmp_path)
+    prev = os.path.join(parent, "prev")
+    Snapshot.take(prev, _state(seed=1))
+    _assert_restores_bit_exact(prev, seed=1)  # artifact lands pre-baseline
+    committed_before = set(_list(parent))
+
+    code = (
+        "import os, numpy as np\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from torchsnapshot_tpu import Snapshot, StateDict\n"
+        "rng = np.random.default_rng(2)\n"
+        "Snapshot.take(os.environ['CHAOS_PATH'], {'s': StateDict(\n"
+        "    w=rng.standard_normal(512).astype(np.float32),\n"
+        "    b=np.arange(64, dtype=np.int64) + 2, step=2)})\n"
+    )
+    env = dict(
+        os.environ,
+        CHAOS_PATH=os.path.join(parent, "cur"),
+        TORCHSNAPSHOT_TPU_FAULTS="op=write,at=1,kind=kill",
+    )
+    env.pop("TORCHSNAPSHOT_TPU_TRACE", None)
+    result = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, timeout=120
+    )
+    assert result.returncode == KILL_EXIT_CODE, result.stderr.decode()[-2000:]
+
+    assert "cur/.snapshot_metadata" not in _list(parent)
+    assert Snapshot(prev).verify() == {}
+    _assert_restores_bit_exact(prev, seed=1)
+    Snapshot.gc(parent, dry_run=False)
+    assert set(_list(parent)) == committed_before
+    snap = Snapshot.take(os.path.join(parent, "cur"), _state(seed=2))
+    assert snap.verify() == {}
+
+
+def test_chaos_gc_cli_dry_run_then_apply(tmp_path, capsys) -> None:
+    from torchsnapshot_tpu.__main__ import main
+
+    parent = str(tmp_path)
+    Snapshot.take(os.path.join(parent, "prev"), _state(seed=1))
+    with knobs.override_faults("op=write,kind=torn,bytes=32,path=0/s"):
+        with pytest.raises(CheckpointAbortedError):
+            Snapshot.take(os.path.join(parent, "cur"), _state(seed=2))
+    debris = [p for p in _list(parent) if ".tmp." in p]
+    assert debris, "torn write should have left fs debris"
+
+    assert main(["gc", parent]) == 0
+    out = capsys.readouterr().out
+    assert "would remove" in out and "dry run" in out
+    assert debris[0] in out
+    assert debris[0] in _list(parent)  # dry run deleted nothing
+
+    assert main(["gc", parent, "--apply"]) == 0
+    out = capsys.readouterr().out
+    assert "removed" in out
+    assert debris[0] not in _list(parent)
+    assert Snapshot(os.path.join(parent, "prev")).verify() == {}
+
+
+# ---------------------------------------------------------------------------
+# Fast multiprocess: cross-rank abort propagation
+# ---------------------------------------------------------------------------
+
+def _worker_rank1_write_fails(rank: int, world_size: int, shared: str) -> None:
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+        StateDict as SD,
+    )
+
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "20"
+    prev = os.path.join(shared, "prev")
+    Snap.take(prev, {"s": SD(v=_np.full(64, rank, _np.float32))})
+
+    if rank == 1:
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = "op=write,kind=fail,path=1/s"
+    try:
+        Snap.take(
+            os.path.join(shared, "cur"),
+            {"s": SD(v=_np.full(64, rank + 10, _np.float32))},
+        )
+        raise AssertionError("faulted take must not commit")
+    except Aborted as e:
+        # BOTH ranks observe the structured abort naming the faulty rank.
+        assert e.rank == 1, (rank, e)
+        assert e.phase == "write", (rank, e)
+    assert not os.path.exists(os.path.join(shared, "cur", ".snapshot_metadata"))
+    # Prior snapshot still fully intact on every rank.
+    assert Snap(prev).verify() == {}
+
+
+@pytest.mark.multiprocess
+def test_chaos_multiprocess_abort_names_failing_rank(tmp_path) -> None:
+    run_with_processes(_worker_rank1_write_fails, nproc=2, args=(str(tmp_path),))
+
+
+def _worker_rank1_killed(rank: int, world_size: int, shared: str) -> None:
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+        StateDict as SD,
+    )
+
+    # Short barrier timeout: the survivor's failure must be prompt.
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "8"
+    os.environ["TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"] = "1"
+    prev = os.path.join(shared, "prev")
+    Snap.take(prev, {"s": SD(v=_np.full(64, rank, _np.float32))})
+
+    if rank == 1:
+        # Injected process kill mid-drain: the closest stand-in for
+        # preemption, through the SAME deterministic spec child ranks read.
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = "op=write,kind=kill,path=1/s"
+    import time as _time
+
+    t0 = _time.monotonic()
+    try:
+        Snap.take(
+            os.path.join(shared, "cur"),
+            {"s": SD(v=_np.full(64, rank + 10, _np.float32))},
+        )
+        raise AssertionError("take must not commit after a rank died")
+    except Aborted:
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 60, f"abort took {elapsed:.1f}s (timeout 8s)"
+    assert not os.path.exists(os.path.join(shared, "cur", ".snapshot_metadata"))
+    assert Snap(prev).verify() == {}
+    # Only the survivor reaches here; the killed rank never reports.
+    with open(os.path.join(shared, f"survivor_{rank}"), "w") as f:
+        f.write("ok")
+
+
+@pytest.mark.multiprocess
+def test_chaos_multiprocess_rank_kill_fails_survivor_promptly(tmp_path) -> None:
+    with pytest.raises(RuntimeError) as exc_info:
+        run_with_processes(_worker_rank1_killed, nproc=2, args=(str(tmp_path),))
+    msg = str(exc_info.value)
+    assert "rank 1" in msg and "died without reporting" in msg, msg
+    assert f"(exitcode {KILL_EXIT_CODE})" in msg, msg
+    # The survivor's in-worker assertions all passed...
+    assert os.path.exists(str(tmp_path / "survivor_0"))
+    # ...and the torn take is invisible while the prior snapshot survives.
+    assert not os.path.exists(str(tmp_path / "cur" / ".snapshot_metadata"))
+    assert Snapshot(str(tmp_path / "prev")).verify() == {}
+
+
+# ---------------------------------------------------------------------------
+# The slow seeded matrix: 20+ distinct fault schedules x backends
+# ---------------------------------------------------------------------------
+
+_ABORT_SCHEDULES = [
+    # Torn writes at different byte counts and operation indices.
+    "op=write,kind=torn,bytes=1,path=0/s",
+    "op=write,kind=torn,bytes=64,path=0/s",
+    "op=write,kind=torn,bytes=4000,path=0/s",
+    "op=write,at=0,kind=torn,bytes=128",
+    "op=write,at=2,kind=torn,bytes=128",
+    # Permanent failures at data, sidecar, and commit-marker writes.
+    "op=write,kind=fail,path=0/s",
+    "op=write,kind=fail,path=.checksums",
+    "op=write,kind=fail,path=.snapshot_metadata",
+    "op=write,at=1,kind=fail",
+    # Stream-path failures (stream writes force the chunked path).
+    "op=stream_open,kind=fail",
+    "op=append,at=1,kind=fail",
+    "op=append,at=3,kind=torn,bytes=100",
+    "op=commit,kind=fail",
+    # Seeded probabilistic storms that eventually fail permanently.
+    "seed=3;op=write,p=0.6,kind=fail",
+    "seed=9;op=write,p=0.6,kind=fail",
+    # A transient storm that outlives the (shrunk) progress window.
+    "backoff=0.01;window=0.05;op=write,kind=transient,path=0/s",
+]
+
+_RESILIENT_SCHEDULES = [
+    # Transient storms under the default window: retried to success.
+    "backoff=0.005;op=write,kind=transient,times=5",
+    "backoff=0.005;seed=5;op=write,p=0.4,kind=transient,times=8",
+    "backoff=0.005;op=read,kind=transient,times=2;op=write,kind=transient,times=2",
+    # Stalls delay but never fail.
+    "op=write,kind=stall,secs=0.05,times=3",
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", _ABORT_SCHEDULES)
+@pytest.mark.parametrize("any_backend", ["fs", "memory", "gcs"], indirect=True)
+def test_chaos_matrix_aborting_schedules(any_backend, spec) -> None:
+    needs_streams = "append" in spec or "commit" in spec or "stream" in spec
+    if needs_streams:
+        with knobs.override_stream_writes(True), knobs.override_stream_chunk_bytes(
+            512
+        ):
+            _chaos_round(any_backend, spec)
+    else:
+        _chaos_round(any_backend, spec)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", _RESILIENT_SCHEDULES)
+@pytest.mark.parametrize("any_backend", ["fs", "memory"], indirect=True)
+def test_chaos_matrix_resilient_schedules(any_backend, spec) -> None:
+    _chaos_round(any_backend, spec, expect_abort=False)
+
+
+def _worker_kill_matrix(rank, world_size, shared, kill_spec) -> None:
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+        StateDict as SD,
+    )
+
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "8"
+    os.environ["TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"] = "1"
+    prev = os.path.join(shared, "prev")
+    Snap.take(prev, {"s": SD(v=_np.full(64, rank, _np.float32))})
+    if rank == 1:
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = kill_spec
+    try:
+        Snap.take(
+            os.path.join(shared, "cur"),
+            {"s": SD(v=_np.full(64, rank + 10, _np.float32))},
+        )
+        raise AssertionError("take must not commit after a rank died")
+    except Aborted:
+        pass
+    assert not os.path.exists(os.path.join(shared, "cur", ".snapshot_metadata"))
+    assert Snap(prev).verify() == {}
+    with open(os.path.join(shared, f"survivor_{rank}"), "w") as f:
+        f.write("ok")
+
+
+# Kill points across the take lifecycle: mid-drain (a data write), at the
+# pre-barrier artifact write (i.e. right before arrive), and at the commit
+# marker itself (rank 0 between arrive and depart is exercised by
+# path=.snapshot_metadata only when rank 0 is the victim; for the rank-1
+# victim it dies pre-arrive, which is the "arrive" kill point).
+_KILL_SPECS = [
+    "op=write,kind=kill,path=1/s",  # drain
+    "op=write,kind=kill,path=.telemetry",  # post-drain, pre-arrive
+    "op=write,at=0,kind=kill",  # first write of the faulted take
+]
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+@pytest.mark.parametrize("kill_spec", _KILL_SPECS)
+def test_chaos_matrix_rank_kill_points(tmp_path, kill_spec) -> None:
+    with pytest.raises(RuntimeError) as exc_info:
+        run_with_processes(
+            _worker_kill_matrix, nproc=2, args=(str(tmp_path), kill_spec)
+        )
+    msg = str(exc_info.value)
+    assert "rank 1" in msg and "died without reporting" in msg, msg
+    assert os.path.exists(str(tmp_path / "survivor_0"))
+    assert not os.path.exists(str(tmp_path / "cur" / ".snapshot_metadata"))
+    assert Snapshot(str(tmp_path / "prev")).verify() == {}
+    # gc from the parent process reclaims the dead rank's debris; the
+    # committed snapshot's files all survive.
+    Snapshot.gc(str(tmp_path), dry_run=False)
+    assert Snapshot(str(tmp_path / "prev")).verify() == {}
+    snap = Snapshot.take(str(tmp_path / "cur2"), _state(seed=3))
+    assert snap.verify() == {}
+
+
+def _worker_rank0_killed_between_arrive_and_depart(rank, world_size, shared):
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+        StateDict as SD,
+    )
+
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "8"
+    os.environ["TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"] = "1"
+    if rank == 0:
+        # Rank 0 dies AT the metadata write: after arrive (all data
+        # durable), before the commit marker lands — the classic
+        # leader-death window.
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = (
+            "op=write,kind=kill,path=.snapshot_metadata"
+        )
+    try:
+        Snap.take(
+            os.path.join(shared, "cur"),
+            {"s": SD(v=_np.full(64, rank, _np.float32))},
+        )
+        raise AssertionError("commit leader died; take must not succeed")
+    except Aborted:
+        pass
+    assert not os.path.exists(os.path.join(shared, "cur", ".snapshot_metadata"))
+    with open(os.path.join(shared, f"survivor_{rank}"), "w") as f:
+        f.write("ok")
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_chaos_leader_death_between_arrive_and_depart(tmp_path) -> None:
+    """Kill the commit leader between barrier arrive and depart: the
+    metadata never lands and the surviving rank fails with the structured
+    abort instead of hanging (satellite: LinearBarrier rank-death
+    propagation, end to end)."""
+    with pytest.raises(RuntimeError) as exc_info:
+        run_with_processes(
+            _worker_rank0_killed_between_arrive_and_depart,
+            nproc=2,
+            args=(str(tmp_path),),
+        )
+    msg = str(exc_info.value)
+    assert "rank 0" in msg and "died without reporting" in msg, msg
+    assert os.path.exists(str(tmp_path / "survivor_1"))
+    assert not os.path.exists(str(tmp_path / "cur" / ".snapshot_metadata"))

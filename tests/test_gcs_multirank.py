@@ -37,9 +37,26 @@ def _worker_env(endpoint: str) -> None:
     os.environ["GOOGLE_CLOUD_PROJECT"] = "test-project"
     os.environ["TORCHSNAPSHOT_TPU_ENABLE_BATCHING"] = "1"
     os.environ["TORCHSNAPSHOT_TPU_SLAB_SIZE_THRESHOLD_BYTES"] = "8192"
-    os.environ["TORCHSNAPSHOT_TPU_COMPRESSION"] = "zstd"
+    # zlib, not zstd: the pod-story composition (slabs + compression +
+    # resumable uploads + commit barrier) is codec-agnostic, and zlib is
+    # stdlib — an optional-dependency skip can't surface from inside a
+    # worker process, it would just fail the whole matrix.
+    os.environ["TORCHSNAPSHOT_TPU_COMPRESSION"] = "zlib"
     os.environ["TORCHSNAPSHOT_TPU_GCS_CHUNK_BYTES"] = str(CHUNK_BYTES)
 
+
+
+def _zeros_global(shape, sharding):
+    """Zeroed multiprocess array without jax.device_put: device_put onto a
+    global sharding runs a jitted consistency psum, which this jax version
+    refuses on the multiprocess CPU backend — make_array_from_callback
+    builds shards host-side with no collective at all."""
+    import jax
+    import numpy as np_
+
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: np_.zeros(shape, "float32")[idx]
+    )
 
 def _worker_cloud_composition(
     rank: int, world_size: int, endpoint: str, prefix: str
@@ -92,15 +109,9 @@ def _worker_cloud_composition(
 
     # Restore into fresh zeroed targets with the same shardings.
     tgt = StateDict(
-        big=jax.device_put(
-            jnp.zeros(big_np.shape, jnp.float32), NamedSharding(mesh, P("x"))
-        ),
-        r0=jax.device_put(
-            jnp.zeros(repl_np[0].shape, jnp.float32), NamedSharding(mesh, P(None))
-        ),
-        r1=jax.device_put(
-            jnp.zeros(repl_np[1].shape, jnp.float32), NamedSharding(mesh, P(None))
-        ),
+        big=_zeros_global(big_np.shape, NamedSharding(mesh, P("x"))),
+        r0=_zeros_global(repl_np[0].shape, NamedSharding(mesh, P(None))),
+        r1=_zeros_global(repl_np[1].shape, NamedSharding(mesh, P(None))),
         **{k: np.zeros_like(v) for k, v in smalls.items()},
     )
     snap.restore({"s": tgt})
@@ -117,10 +128,7 @@ def _worker_cloud_composition(
     # emulator — overlap-scatter planning drives ranged HTTP reads of the
     # saved shard objects.
     tgt2 = StateDict(
-        big=jax.device_put(
-            jnp.zeros(big_np.shape, jnp.float32),
-            NamedSharding(mesh, P(None, "x")),
-        )
+        big=_zeros_global(big_np.shape, NamedSharding(mesh, P(None, "x")))
     )
     snap.restore({"s": tgt2})
     # The restored array must keep the transposed donor layout — a silent

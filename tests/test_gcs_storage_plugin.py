@@ -6,6 +6,7 @@ test is env-var gated and skips when no bucket is configured.
 """
 
 import asyncio
+import importlib.util
 import os
 import sys
 import types
@@ -32,6 +33,7 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
     class FakeBlob:
         def __init__(self, name: str) -> None:
             self._name = name
+            self.name = name  # the real SDK exposes .name (list_blobs/gc)
 
         def upload_from_file(self, fileobj, size=None, rewind=False) -> None:
             if rewind:
@@ -75,6 +77,13 @@ def _install_fake_gcs(monkeypatch, blobs: dict, fail_reads: dict) -> None:
     class FakeClient:
         def bucket(self, name: str) -> FakeBucket:
             return FakeBucket(name)
+
+        def list_blobs(self, bucket_name: str, prefix=None):
+            return [
+                FakeBlob(n)
+                for n in sorted(blobs)
+                if prefix is None or n.startswith(prefix)
+            ]
 
     storage_mod = types.ModuleType("google.cloud.storage")
     storage_mod.Client = FakeClient
@@ -360,6 +369,10 @@ def test_incremental_take_uses_server_side_copies(fake_gcs, monkeypatch) -> None
     assert np.array_equal(out["b2"], frozen["b2"])
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("zstandard") is None,
+    reason="zstandard not installed (optional dependency)",
+)
 def test_incremental_server_side_copies_compressed_slabs(fake_gcs) -> None:
     """Member-framed compressed slabs dedup on GCS too: slab paths are
     fresh batched/<uuid> every take, so the content-keyed index must drive

@@ -118,3 +118,85 @@ def test_linear_barrier_error_propagation() -> None:
     t0.join(), t1.join()
     assert results[1] == "reported"
     assert "barrier-error" in results[0] and "boom" in results[0]
+
+
+def test_linear_barrier_error_carries_rank_and_phase() -> None:
+    """report_error(phase=...) reaches peers as a structured BarrierError:
+    the failing rank and its take phase ride the store payload, so callers
+    can raise a CheckpointAbortedError naming both."""
+    store = LocalStore()
+    world = 2
+    caught = {}
+
+    def good(rank):
+        b = LinearBarrier(store, "b3", rank, world)
+        try:
+            b.arrive(timeout_s=5)
+            b.depart(timeout_s=5)
+        except BarrierError as e:
+            caught[rank] = e
+
+    def bad(rank):
+        b = LinearBarrier(store, "b3", rank, world)
+        b.report_error(RuntimeError("disk on fire"), phase="write")
+
+    t0 = threading.Thread(target=good, args=(0,))
+    t1 = threading.Thread(target=bad, args=(1,))
+    t0.start(), t1.start()
+    t0.join(), t1.join()
+    e = caught[0]
+    assert e.rank == 1 and e.phase == "write"
+    assert "rank 1" in str(e) and "write" in str(e) and "disk on fire" in str(e)
+
+
+def test_linear_barrier_legacy_error_payload_tolerated() -> None:
+    """A (rank, msg) 2-tuple from a pre-phase-tagging writer still parses:
+    mixed-version pods fail cleanly, not with an unpack crash."""
+    import pickle
+
+    store = LocalStore()
+    b = LinearBarrier(store, "b-legacy", 0, 2)
+    store.set("barrier/b-legacy/error", pickle.dumps((1, "old-style boom")))
+    with pytest.raises(BarrierError, match="rank 1 failed: old-style boom"):
+        b.arrive(timeout_s=5)
+
+
+@pytest.mark.parametrize("death_point", ["before_arrive", "between_phases"])
+def test_linear_barrier_rank_death_times_out_peers(death_point) -> None:
+    """A rank that dies WITHOUT reporting — before arriving, or between
+    arrive and depart (the preemption window: its data is durable but it
+    never sees the commit) — must fail the surviving ranks with the barrier
+    TimeoutError within the timeout, never hang them."""
+    store = LocalStore()
+    world = 2
+    outcome = {}
+
+    def survivor(rank):
+        b = LinearBarrier(store, "b4", rank, world)
+        t0 = time.monotonic()
+        try:
+            b.arrive(timeout_s=2)
+            b.depart(timeout_s=2)
+            outcome[rank] = "ok"
+        except TimeoutError as e:
+            outcome[rank] = ("timeout", time.monotonic() - t0, str(e))
+        except BarrierError as e:
+            outcome[rank] = ("barrier-error", time.monotonic() - t0, str(e))
+
+    def doomed(rank):
+        b = LinearBarrier(store, "b4", rank, world)
+        if death_point == "between_phases":
+            b.arrive(timeout_s=2)
+        # ...and the thread simply exits: a SIGKILLed process writes
+        # neither an error report nor its depart increment.
+
+    t0 = threading.Thread(target=survivor, args=(0,))
+    t1 = threading.Thread(target=doomed, args=(1,))
+    t0.start(), t1.start()
+    t0.join(), t1.join()
+    kind, elapsed, msg = outcome[0]
+    assert kind == "timeout", outcome
+    assert "timed out" in msg
+    # Prompt: bounded by (at most) the two phases' timeouts plus polling
+    # slack, not a hang.
+    assert elapsed < 10, elapsed

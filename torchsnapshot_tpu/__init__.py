@@ -14,7 +14,7 @@ The public interface is deliberately tiny (reference ``__init__.py:35-41``):
 
 from .io_types import StoragePlugin
 from .rng_state import RNGState
-from .snapshot import PendingSnapshot, Snapshot
+from .snapshot import CheckpointAbortedError, PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
 from .version import __version__
@@ -27,5 +27,6 @@ __all__ = [
     "RNGState",
     "AppState",
     "StoragePlugin",
+    "CheckpointAbortedError",
     "__version__",
 ]

@@ -22,6 +22,13 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         from the storage plugin itself, so what you see is what a restore
         pays per request.
 
+    python -m torchsnapshot_tpu gc <path> [--apply]
+        Reclaim crash debris: whole uncommitted snapshot trees (no
+        ``.snapshot_metadata`` — invisible to readers by the atomic-commit
+        contract) and files a committed manifest does not reference (temp
+        files and data objects of torn takes). Dry-run by default; --apply
+        deletes. See docs/robustness.md.
+
     python -m torchsnapshot_tpu stats <snapshot-path> [--trace out.json]
         Fleet view from the persisted ``.telemetry/rank_*.json`` artifacts
         alone (no live process needed): per-rank phase/byte breakdown,
@@ -179,6 +186,25 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_gc(args: argparse.Namespace) -> int:
+    from .snapshot import Snapshot
+
+    report = Snapshot.gc(args.path, dry_run=not args.apply)
+    for root in report["committed"]:
+        print(f"committed: {root or '.'}")
+    for root in report["uncommitted"]:
+        print(f"uncommitted (whole tree is debris): {root or '.'}")
+    verb = "removed" if args.apply else "would remove"
+    for p in report["remove"]:
+        print(f"{verb}: {p}")
+    print(
+        f"{len(report['keep'])} file(s) kept, "
+        f"{len(report['remove'])} debris file(s) "
+        f"{'removed' if args.apply else 'found (dry run; pass --apply to delete)'}"
+    )
+    return 0
+
+
 def _cmd_stats(args: argparse.Namespace) -> int:
     from . import telemetry
     from .telemetry import aggregate as agg_mod
@@ -279,6 +305,21 @@ def main(argv=None) -> int:
         help="Chrome/Perfetto trace-event JSON destination (default: trace.json)",
     )
     p_trace.set_defaults(fn=_cmd_trace)
+
+    p_gc = sub.add_parser(
+        "gc",
+        help=(
+            "reclaim crash debris: uncommitted snapshot trees and files "
+            "unreferenced by the committed manifest (dry-run by default)"
+        ),
+    )
+    p_gc.add_argument("path")
+    p_gc.add_argument(
+        "--apply",
+        action="store_true",
+        help="actually delete the debris (default: dry-run report only)",
+    )
+    p_gc.set_defaults(fn=_cmd_gc)
 
     p_stats = sub.add_parser(
         "stats",

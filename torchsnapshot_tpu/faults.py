@@ -1,0 +1,468 @@
+"""Deterministic fault injection for storage plugins.
+
+The robustness analogue of the telemetry layer: every crash-consistency
+claim this library makes (atomic commit, abort-leaves-nothing streams,
+collective-progress retry, barrier error propagation) is only as good as
+the failure scenarios that exercise it, and real storage faults are neither
+deterministic nor portable across backends. :class:`FaultyStoragePlugin`
+wraps ANY :class:`~.io_types.StoragePlugin` and injects faults from a
+seeded, fully deterministic spec, so the chaos harness
+(``tests/test_chaos.py``) can replay the exact same torn write / transient
+storm / stall / process kill on fs, memory, and (fake) cloud backends alike.
+
+Installation: the ``TORCHSNAPSHOT_TPU_FAULTS`` knob. When set,
+``url_to_storage_plugin`` wraps every plugin it constructs — including the
+ones child ranks of multiprocess tests construct, since the env var is
+inherited — so a single string drives fault injection across a whole fake
+pod. Production jobs leave it unset; the wrapper is never even imported.
+
+Spec grammar (rules separated by ``;``, fields by ``,``)::
+
+    TORCHSNAPSHOT_TPU_FAULTS = "rule[;rule...]"
+    rule  = seed=<int>                      # global RNG seed (default 0)
+          | backoff=<float>                 # transient-retry base backoff (s)
+          | window=<float>                  # collective-progress window (s)
+          | op=<op>[,<field>=<value>...]    # one injection rule
+
+    op    = write | read | delete | stream_open | append | commit | abort
+          | link | list | any
+    kind  = transient  raise a retryable error (drives cloud_retry)
+          | fail       raise a permanent InjectedFault
+          | torn       transfer `bytes` bytes, then fail WITHOUT abort
+          |            (simulated crash: atomic backends must expose nothing,
+          |            fs leaves a temp file for gc to reclaim)
+          | stall      sleep `secs` seconds before the op (drives the
+          |            stall watchdog)
+          | kill       os._exit the process at the op (preemption)
+
+    fields:
+      at=<k>        inject at the k-th op of this class (0-based; once)
+      after=<k>     inject on every op of this class with index >= k
+      every=<n>     inject on every n-th op of this class
+      p=<float>     inject with this probability (seeded RNG — deterministic
+                    for a given seed + op sequence)
+      times=<n>     cap total injections for this rule (default: 1 for
+                    `at`, unlimited otherwise)
+      rank=<r>      only inject on this rank (env rank / jax process index)
+      path=<substr> only inject on ops whose path contains this substring
+      bytes=<k>     torn mode: bytes transferred before the failure
+      secs=<f>      stall mode: sleep duration
+
+Examples::
+
+    op=write,at=2,kind=kill                    # die at the 3rd object write
+    op=append,kind=transient,times=3           # 3 retryable append failures
+    op=write,path=.snapshot_metadata,kind=fail # commit can never land
+    seed=7;op=write,p=0.2,kind=torn,bytes=100  # seeded 20% torn writes
+
+Every op class keeps its own monotonic counter on the wrapper instance;
+plugins are constructed fresh per take/restore, so counters (and thus
+`at=`/`every=` schedules) are reproducible run to run. Retries count as new
+ops — a transient rule with ``times=2`` fails twice and then passes.
+
+Transient faults are retried by the wrapper itself through the shared
+:func:`~.storage_plugins.cloud_retry.retry_transient` machinery (the same
+policy the GCS/S3 plugins use), so injecting them exercises the real
+backoff/collective-progress code paths, not a test double.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from . import telemetry
+from .io_types import (
+    ReadIO,
+    StoragePlugin,
+    StorageWriteStream,
+    WriteIO,
+)
+from .storage_plugins.cloud_retry import CollectiveProgress, retry_transient
+
+logger = logging.getLogger(__name__)
+
+_OPS = (
+    "write",
+    "read",
+    "delete",
+    "stream_open",
+    "append",
+    "commit",
+    "abort",
+    "link",
+    "list",
+    "any",
+)
+_KINDS = ("transient", "fail", "torn", "stall", "kill")
+
+# Exit code of a `kill` fault — distinctive so the chaos harness (and a
+# human reading a CI log) can tell an injected death from a real crash.
+KILL_EXIT_CODE = 87
+
+
+class InjectedFault(RuntimeError):
+    """A permanently-failing injected fault (``kind=fail`` / ``kind=torn``)."""
+
+
+class InjectedTransientFault(InjectedFault):
+    """A retryable injected fault (``kind=transient``): the wrapper's own
+    retry loop — the shared cloud_retry machinery — classifies exactly this
+    type as transient."""
+
+
+class FaultSpecError(ValueError):
+    """The ``TORCHSNAPSHOT_TPU_FAULTS`` spec string does not parse."""
+
+
+@dataclass
+class FaultRule:
+    op: str
+    kind: str
+    at: Optional[int] = None
+    after: Optional[int] = None
+    every: Optional[int] = None
+    p: Optional[float] = None
+    times: Optional[int] = None
+    rank: Optional[int] = None
+    path: Optional[str] = None
+    bytes: int = 0
+    secs: float = 0.0
+    injected: int = 0  # how often this rule has fired (mutable state)
+
+    def matches(self, op: str, index: int, path: str, rng: random.Random,
+                rank: int) -> bool:
+        if self.op != "any" and self.op != op:
+            return False
+        if self.rank is not None and self.rank != rank:
+            return False
+        if self.path is not None and self.path not in path:
+            return False
+        limit = self.times if self.times is not None else (
+            1 if self.at is not None else None
+        )
+        if limit is not None and self.injected >= limit:
+            return False
+        if self.at is not None:
+            return index == self.at
+        if self.after is not None:
+            return index >= self.after
+        if self.every is not None:
+            return index % self.every == self.every - 1
+        if self.p is not None:
+            # One seeded draw per (matching) op: deterministic for a given
+            # seed + op sequence, independent of wall clock.
+            return rng.random() < self.p
+        # No selector: fire on every matching op (bounded by `times`).
+        return True
+
+
+@dataclass
+class FaultPlan:
+    rules: List[FaultRule] = field(default_factory=list)
+    seed: int = 0
+    backoff_s: Optional[float] = None
+    window_s: Optional[float] = None
+
+
+_INT_FIELDS = ("at", "after", "every", "times", "rank", "bytes")
+_FLOAT_FIELDS = ("p", "secs")
+
+
+def parse_fault_spec(spec: str) -> FaultPlan:
+    """Parse a ``TORCHSNAPSHOT_TPU_FAULTS`` string into a :class:`FaultPlan`.
+
+    Raises :class:`FaultSpecError` on any malformed input — a typo'd chaos
+    schedule must fail the test loudly, not silently inject nothing.
+    """
+    plan = FaultPlan()
+    for raw_rule in spec.split(";"):
+        raw_rule = raw_rule.strip()
+        if not raw_rule:
+            continue
+        fields: Dict[str, str] = {}
+        for raw_field in raw_rule.split(","):
+            key, sep, value = raw_field.partition("=")
+            key = key.strip()
+            if not sep or not key or not value.strip():
+                raise FaultSpecError(
+                    f"malformed field {raw_field!r} in rule {raw_rule!r} "
+                    "(expected key=value)"
+                )
+            if key in fields:
+                raise FaultSpecError(
+                    f"duplicate field {key!r} in rule {raw_rule!r}"
+                )
+            fields[key] = value.strip()
+        try:
+            if "op" not in fields:
+                # Global settings rule: seed / backoff / window only.
+                for key, value in fields.items():
+                    if key == "seed":
+                        plan.seed = int(value)
+                    elif key == "backoff":
+                        plan.backoff_s = float(value)
+                    elif key == "window":
+                        plan.window_s = float(value)
+                    else:
+                        raise FaultSpecError(
+                            f"unknown global field {key!r} in {raw_rule!r} "
+                            "(rules need op=...)"
+                        )
+                continue
+            op = fields.pop("op")
+            if op not in _OPS:
+                raise FaultSpecError(
+                    f"unknown op {op!r} (expected one of {', '.join(_OPS)})"
+                )
+            kind = fields.pop("kind", None)
+            if kind not in _KINDS:
+                raise FaultSpecError(
+                    f"rule {raw_rule!r} needs kind= one of {', '.join(_KINDS)}"
+                )
+            rule = FaultRule(op=op, kind=kind)
+            for key, value in fields.items():
+                if key in _INT_FIELDS:
+                    setattr(rule, key, int(value))
+                elif key in _FLOAT_FIELDS:
+                    setattr(rule, key, float(value))
+                elif key == "path":
+                    rule.path = value
+                else:
+                    raise FaultSpecError(
+                        f"unknown field {key!r} in rule {raw_rule!r}"
+                    )
+        except FaultSpecError:
+            raise
+        except ValueError as e:
+            raise FaultSpecError(f"bad value in rule {raw_rule!r}: {e}") from e
+        if rule.kind == "torn" and rule.op not in ("write", "append", "any"):
+            raise FaultSpecError(
+                f"kind=torn applies to write/append ops, not {rule.op!r}"
+            )
+        plan.rules.append(rule)
+    return plan
+
+
+def _current_rank() -> int:
+    """This process's rank, for ``rank=`` rule filters: the TCPStore
+    coordination knob when set (multiprocess tests), else the jax process
+    index when jax.distributed is up, else 0."""
+    from .utils import knobs
+
+    env_rank = knobs.get_env_rank()
+    if env_rank is not None:
+        return env_rank
+    try:
+        from .parallel.store import JaxCoordinationStore
+
+        if JaxCoordinationStore.available():
+            import jax
+
+            return jax.process_index()
+    except Exception:  # pragma: no cover - jax runtime hiccup
+        pass
+    return 0
+
+
+@dataclass
+class _Action:
+    kind: str
+    rule: FaultRule
+
+
+class FaultyStoragePlugin(StoragePlugin):
+    """Wraps any plugin, injecting faults per a :class:`FaultPlan`.
+
+    Transparent when no rule matches: every call (including the streaming
+    protocol and capability flags) proxies to the inner plugin. Transient
+    faults are retried here through the shared ``cloud_retry`` machinery, so
+    a transient storm exercises the real backoff + collective-progress
+    window; everything else surfaces exactly where a real backend fault
+    would."""
+
+    def __init__(self, inner: StoragePlugin, plan: FaultPlan) -> None:
+        self.inner = inner
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self._counters: Dict[str, int] = {}
+        self._rank = _current_rank()
+        self._progress = CollectiveProgress(
+            window_s=plan.window_s
+        ) if plan.window_s is not None else CollectiveProgress()
+
+    # Capability flags proxy the inner plugin: the scheduler's streaming
+    # gate and IO-concurrency scaling must behave as if the wrapper were
+    # not there.
+    @property
+    def supports_streaming(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self.inner, "supports_streaming", False))
+
+    @property
+    def scales_io_with_local_world(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self.inner, "scales_io_with_local_world", False))
+
+    # ------------------------------------------------------------- injection
+    def _next_action(self, op: str, path: str) -> Optional[_Action]:
+        index = self._counters.get(op, 0)
+        self._counters[op] = index + 1
+        for rule in self.plan.rules:
+            if rule.matches(op, index, path, self._rng, self._rank):
+                rule.injected += 1
+                return _Action(kind=rule.kind, rule=rule)
+        return None
+
+    async def _guard(self, op: str, path: str) -> Optional[_Action]:
+        """Run the injection point for one op. Raises / stalls / kills per
+        the matched rule; returns the action for kinds the caller must
+        implement itself (torn)."""
+        act = self._next_action(op, path)
+        if act is None:
+            return None
+        telemetry.counter_add(f"faults.{act.kind}")
+        if act.kind == "stall":
+            logger.warning(
+                "FAULT stall %.2fs on %s %s", act.rule.secs, op, path
+            )
+            await asyncio.sleep(act.rule.secs)
+            return None
+        if act.kind == "kill":
+            logger.warning("FAULT kill at %s %s", op, path)
+            # os._exit: no atexit, no finally blocks — the closest portable
+            # stand-in for SIGKILL-style preemption.
+            os._exit(KILL_EXIT_CODE)
+        if act.kind == "transient":
+            raise InjectedTransientFault(f"injected transient {op} fault: {path}")
+        if act.kind == "fail":
+            raise InjectedFault(f"injected {op} failure: {path}")
+        return act  # torn: the caller transfers partial bytes then fails
+
+    async def _retrying(self, run, label: str):
+        return await retry_transient(
+            run,
+            lambda e: isinstance(e, InjectedTransientFault),
+            self._progress,
+            label,
+            base_backoff_s=self.plan.backoff_s,
+        )
+
+    # ------------------------------------------------------------------- ops
+    async def write(self, write_io: WriteIO) -> None:
+        async def run() -> None:
+            act = await self._guard("write", write_io.path)
+            if act is not None and act.kind == "torn":
+                # Simulated crash mid-write: push `bytes` bytes into a real
+                # stream of the inner plugin and die without commit OR
+                # abort. Atomic backends must expose no object; fs leaves
+                # its temp file behind as crash debris for gc.
+                stream = await self.inner.write_stream(write_io.path)
+                mv = memoryview(write_io.buf).cast("B")
+                await stream.append(mv[: act.rule.bytes])
+                raise InjectedFault(
+                    f"injected torn write after {act.rule.bytes} bytes: "
+                    f"{write_io.path}"
+                )
+            await self.inner.write(write_io)
+
+        await self._retrying(run, "faults")
+
+    async def read(self, read_io: ReadIO) -> None:
+        async def run() -> None:
+            await self._guard("read", read_io.path)
+            # A retried read must not append to a buffer a failed attempt
+            # already partially filled.
+            read_io.buf.seek(0)
+            read_io.buf.truncate(0)
+            await self.inner.read(read_io)
+
+        await self._retrying(run, "faults")
+
+    async def delete(self, path: str) -> None:
+        async def run() -> None:
+            await self._guard("delete", path)
+            await self.inner.delete(path)
+
+        await self._retrying(run, "faults")
+
+    async def write_stream(self, path: str) -> StorageWriteStream:
+        async def run() -> StorageWriteStream:
+            await self._guard("stream_open", path)
+            return await self.inner.write_stream(path)
+
+        inner_stream = await self._retrying(run, "faults")
+        return _FaultyWriteStream(self, path, inner_stream)
+
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        await self._guard("link", path)
+        return await self.inner.link_in(src_abs_path, path)
+
+    async def list_prefix(self, prefix: str) -> List[str]:
+        async def run() -> List[str]:
+            await self._guard("list", prefix)
+            return await self.inner.list_prefix(prefix)
+
+        return await self._retrying(run, "faults")
+
+    async def prune_empty(self) -> None:
+        await self.inner.prune_empty()
+
+    async def close(self) -> None:
+        await self.inner.close()
+
+
+class _FaultyWriteStream(StorageWriteStream):
+    """Injects at append/commit/abort; otherwise proxies the inner stream."""
+
+    def __init__(
+        self,
+        plugin: FaultyStoragePlugin,
+        path: str,
+        inner: StorageWriteStream,
+    ) -> None:
+        self._plugin = plugin
+        self._path = path
+        self._inner = inner
+
+    async def append(self, buf) -> None:
+        async def run() -> None:
+            act = await self._plugin._guard("append", self._path)
+            if act is not None and act.kind == "torn":
+                mv = memoryview(buf).cast("B")
+                await self._inner.append(mv[: act.rule.bytes])
+                raise InjectedFault(
+                    f"injected torn append after {act.rule.bytes} bytes: "
+                    f"{self._path}"
+                )
+            await self._inner.append(buf)
+
+        # NOT retried: appends are ordered and stateful — a blind re-append
+        # after a partial transfer would corrupt the stream. Real plugins
+        # retry *inside* their append (per-part/per-chunk); injected append
+        # faults therefore surface to the caller, whose job is to abort.
+        await run()
+
+    async def commit(self) -> None:
+        await self._plugin._guard("commit", self._path)
+        await self._inner.commit()
+
+    async def abort(self) -> None:
+        await self._plugin._guard("abort", self._path)
+        await self._inner.abort()
+
+
+def maybe_wrap_with_faults(plugin: StoragePlugin) -> StoragePlugin:
+    """Wrap ``plugin`` when the ``TORCHSNAPSHOT_TPU_FAULTS`` knob is set.
+
+    Called by ``url_to_storage_plugin`` on every plugin it constructs; a
+    malformed spec raises immediately (tests must fail loudly, and the knob
+    never reaches production jobs)."""
+    from .utils import knobs
+
+    spec = knobs.get_faults_spec()
+    if not spec:
+        return plugin
+    return FaultyStoragePlugin(plugin, parse_fault_spec(spec))

@@ -216,6 +216,20 @@ def _is_oom_error(e: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in s or "out of memory" in s.lower()
 
 
+# Log-once guard for the backend-capability degradation below.
+_fork_unsupported_warned = False
+
+
+def _is_fork_unsupported_error(e: BaseException) -> bool:
+    """The batched copy is impossible on this backend — notably jax's CPU
+    backend, which refuses multiprocess jitted computations outright
+    (INVALID_ARGUMENT), regardless of size. Bisection can't help; the whole
+    group must capture through host RAM (the reference's design, still
+    donation-safe)."""
+    s = str(e)
+    return "implemented on the CPU backend" in s
+
+
 def _try_fork(group: List[Any], forked_bytes: List[int]) -> List[Any]:
     """One batched jitted copy of ``group``; raises on allocation failure.
 
@@ -255,7 +269,19 @@ def _fork_or_capture(
     ``_defensive_device_copies``)."""
     try:
         return _try_fork(group, forked_bytes)
-    except Exception as e:  # noqa: BLE001 - only OOM degrades
+    except Exception as e:  # noqa: BLE001 - only OOM/capability degrades
+        if _is_fork_unsupported_error(e):
+            global _fork_unsupported_warned
+            if not _fork_unsupported_warned:
+                _fork_unsupported_warned = True
+                logger.warning(
+                    "async_take defensive device fork is unsupported on "
+                    "this backend (%s); capturing through host RAM instead "
+                    "— donation-safe, but the blocking D2H joins the take "
+                    "stall",
+                    e,
+                )
+            return _host_capture_group(group)
         if not _is_oom_error(e):
             raise
     if len(group) == 1 or depth >= _MAX_FORK_BISECT_DEPTH:

@@ -16,7 +16,7 @@ import asyncio
 import io
 from concurrent.futures import Executor
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Optional, Tuple, Union
+from typing import AsyncIterator, List, Optional, Tuple, Union
 
 # A staged buffer is either raw bytes or a zero-copy view over host memory.
 BufferType = Union[bytes, bytearray, memoryview]
@@ -239,6 +239,21 @@ class StoragePlugin(abc.ABC):
         snapshots). Returns False when unsupported or failed — the caller
         falls back to a normal write. Default: unsupported."""
         return False
+
+    async def list_prefix(self, prefix: str) -> List[str]:
+        """All object paths under ``prefix`` (relative to the plugin root,
+        ``""`` = everything). The substrate of ``Snapshot.gc``: debris from
+        torn takes can only be reclaimed on backends that can enumerate it.
+        Built-in plugins all implement this; third-party plugins that don't
+        simply can't be garbage-collected."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support listing; Snapshot.gc "
+            "requires a plugin with list_prefix"
+        )
+
+    async def prune_empty(self) -> None:
+        """Remove now-empty directories after deletions, where the backend
+        has real directories (fs). Object stores have none: default no-op."""
 
     async def close(self) -> None:
         pass

@@ -83,6 +83,14 @@ class Coordinator:
             except Exception:
                 break  # cleanup is best-effort
 
+    def note_external_barrier(self) -> None:
+        """An out-of-band full-world rendezvous completed (e.g. the commit
+        LinearBarrier's depart): every rank has finished every coordinator
+        collective it issued before arriving, so keys this rank posted in
+        earlier generations are safe to collect. Main-thread only, like the
+        collectives themselves."""
+        self._last_barrier_gen = self._generation
+
     # -- collectives --------------------------------------------------------
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         if self._world_size == 1:

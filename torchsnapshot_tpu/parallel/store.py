@@ -171,6 +171,22 @@ class LocalStore(Store):
 class JaxCoordinationStore(Store):
     """Rides ``jax.distributed``'s coordination service (usable off-thread)."""
 
+    # Client methods the Store contract needs. jax versions differ here —
+    # e.g. 0.4.x's DistributedRuntimeClient ships the get/set/delete family
+    # but NOT key_value_increment / key_value_try_get_bytes. On such
+    # versions ``available()`` returns False (logged once) so the
+    # coordinator falls back to a TCPStore instead of dying with an
+    # AttributeError inside the first barrier — and leaving peers hanging
+    # until their store timeout.
+    _REQUIRED_CLIENT_OPS = (
+        "key_value_set_bytes",
+        "blocking_key_value_get_bytes",
+        "key_value_try_get_bytes",
+        "key_value_increment",
+        "key_value_delete",
+    )
+    _capability_warned = False
+
     def __init__(self, namespace: str = "tss") -> None:
         from jax._src import distributed
 
@@ -180,15 +196,42 @@ class JaxCoordinationStore(Store):
                 "jax.distributed is not initialized; "
                 "call jax.distributed.initialize() or provide a TCPStore"
             )
+        missing = [
+            op for op in self._REQUIRED_CLIENT_OPS if not hasattr(client, op)
+        ]
+        if missing:
+            raise RuntimeError(
+                "this jax version's coordination-service client lacks "
+                f"{', '.join(missing)}; use a TCPStore "
+                "(TORCHSNAPSHOT_TPU_STORE_ADDR) for checkpoint coordination"
+            )
         self._client = client
         self._ns = namespace
 
-    @staticmethod
-    def available() -> bool:
+    @classmethod
+    def available(cls) -> bool:
         try:
             from jax._src import distributed
 
-            return distributed.global_state.client is not None
+            client = distributed.global_state.client
+            if client is None:
+                return False
+            missing = [
+                op for op in cls._REQUIRED_CLIENT_OPS if not hasattr(client, op)
+            ]
+            if missing:
+                if not cls._capability_warned:
+                    cls._capability_warned = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "jax.distributed is initialized but its coordination "
+                        "client lacks %s; falling back to TCPStore "
+                        "coordination (TORCHSNAPSHOT_TPU_STORE_ADDR)",
+                        ", ".join(missing),
+                    )
+                return False
+            return True
         except Exception:
             return False
 
@@ -398,7 +441,17 @@ def free_port() -> int:
 # ---------------------------------------------------------------------------
 
 class BarrierError(RuntimeError):
-    pass
+    """A peer reported failure through the barrier. Carries the failing
+    rank and the phase of the take it was in (``None`` for reports from
+    pre-phase-tagging writers) so callers can surface a structured
+    :class:`~torchsnapshot_tpu.CheckpointAbortedError` instead of a bare
+    string."""
+
+    def __init__(self, message: str, rank: Optional[int] = None,
+                 phase: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.rank = rank
+        self.phase = phase
 
 
 class LinearBarrier:
@@ -436,6 +489,21 @@ class LinearBarrier:
 
         return knobs.get_barrier_timeout_s()
 
+    @staticmethod
+    def _unpickle_error(err: bytes) -> "BarrierError":
+        payload = pickle.loads(err)
+        # Current writers post (rank, phase, msg); tolerate the legacy
+        # 2-tuple so mixed-version pods still fail cleanly, not cryptically.
+        if len(payload) == 3:
+            rank, phase, msg = payload
+        else:
+            rank, msg = payload
+            phase = None
+        detail = f" during {phase}" if phase else ""
+        return BarrierError(
+            f"rank {rank} failed{detail}: {msg}", rank=rank, phase=phase
+        )
+
     def _phase(self, phase: str, timeout_s: float) -> None:
         count = self._store.add(phase, 1)
         if count == self._world_size:
@@ -444,16 +512,14 @@ class LinearBarrier:
         while True:
             err = self._store.try_get("error")
             if err is not None:
-                rank, msg = pickle.loads(err)
-                raise BarrierError(f"rank {rank} failed: {msg}")
+                raise self._unpickle_error(err)
             try:
                 self._store.get(f"{phase}/done", timeout_s=1.0)
                 # report_error() force-sets the done keys to unblock waiters,
                 # so re-check for a peer failure before declaring success.
                 err = self._store.try_get("error")
                 if err is not None:
-                    rank, msg = pickle.loads(err)
-                    raise BarrierError(f"rank {rank} failed: {msg}")
+                    raise self._unpickle_error(err)
                 return
             except TimeoutError:
                 if time.monotonic() > deadline:
@@ -462,8 +528,10 @@ class LinearBarrier:
                         f"({count}/{self._world_size} arrived)"
                     )
 
-    def report_error(self, e: Exception) -> None:
-        self._store.set("error", pickle.dumps((self._rank, repr(e))))
+    def report_error(self, e: Exception, phase: Optional[str] = None) -> None:
+        self._store.set(
+            "error", pickle.dumps((self._rank, phase, repr(e)))
+        )
         # Unblock peers waiting on phase-done keys; they'll see the error.
         self._store.set("arrive/done", b"1")
         self._store.set("depart/done", b"1")

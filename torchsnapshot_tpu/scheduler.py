@@ -500,8 +500,15 @@ class _WritePipeline:
             self.pending.popleft()
             self.budget.debit(cost)
             if stream:
-                task = asyncio.ensure_future(self._stream_one(req, cost))
-                self.stream_tasks[task] = (req, time.monotonic())
+                # `started` marks whether the coroutine ever ran: an abort
+                # that cancels a never-started stream must credit its
+                # admission reservation itself (the coroutine's own
+                # finally-credits never execute).
+                started = [False]
+                task = asyncio.ensure_future(
+                    self._stream_one(req, cost, started)
+                )
+                self.stream_tasks[task] = (req, time.monotonic(), cost, started)
             else:
                 task = asyncio.ensure_future(
                     req.buffer_stager.stage_buffer(self.executor)
@@ -516,7 +523,12 @@ class _WritePipeline:
             task = asyncio.ensure_future(self._write_one(path, buf))
             self.io_tasks[task] = (nbytes, time.monotonic(), path)
 
-    async def _stream_one(self, req: WriteReq, admitted_cost: int) -> None:
+    async def _stream_one(
+        self,
+        req: WriteReq,
+        admitted_cost: int,
+        started: Optional[list] = None,
+    ) -> None:
         """Drive ONE streamed request end to end: a staging producer
         (``stage_chunks``) and an append consumer connected by a bounded
         queue, so the storage write of chunk *k* overlaps the
@@ -528,6 +540,8 @@ class _WritePipeline:
         sha256 over the chunk sequence == the whole object's digest), and a
         mid-stream failure aborts the storage stream — no partial object is
         ever committed."""
+        if started is not None:
+            started[0] = True
         stager = req.buffer_stager
         budget = self.budget
         chunk_est = knobs.get_stream_chunk_bytes()
@@ -752,11 +766,53 @@ class _WritePipeline:
                         return
         await self.storage.write(WriteIO(path=path, buf=buf))
 
+    @property
+    def budget_balanced(self) -> bool:
+        """True when every debit has been credited back — the invariant an
+        aborted take must restore (chaos-harness assertion surface)."""
+        return self.budget.available == self.budget.total
+
+    async def _abort_inflight(self) -> None:
+        """Failure path: cancel every in-flight task, await them, and credit
+        back every outstanding budget debit, so an aborted take leaves the
+        budget balanced and no staging/io coroutine running against a
+        torn-down pipeline. Stream tasks that ever started credit their own
+        debits in their finally blocks; never-started ones are credited
+        here (their coroutine bodies never ran)."""
+        tasks = (
+            list(self.staging_tasks)
+            + list(self.io_tasks)
+            + list(self.stream_tasks)
+        )
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        for _req, cost, _t0 in self.staging_tasks.values():
+            self.budget.credit(cost)
+        self.staging_tasks.clear()
+        for nbytes, _t0, _path in self.io_tasks.values():
+            self.budget.credit(nbytes)
+        self.io_tasks.clear()
+        for _req, _t0, cost, started in self.stream_tasks.values():
+            if not started[0]:
+                self.budget.credit(cost)
+        self.stream_tasks.clear()
+        while self.ready_for_io:
+            _path, buf = self.ready_for_io.popleft()
+            self.budget.credit(memoryview(buf).nbytes)
+
     def _reap(self, done) -> None:
         for task in done:
             if task in self.staging_tasks:
                 req, cost, t0 = self.staging_tasks.pop(task)
-                buf = task.result()
+                try:
+                    buf = task.result()
+                except BaseException:
+                    # Failed staging releases its reservation: the task is
+                    # already popped, so nobody else can credit it.
+                    self.budget.credit(cost)
+                    raise
                 nbytes = memoryview(buf).nbytes
                 self._record_task("stage", t0, req.path, nbytes)
                 self.bytes_staged += nbytes
@@ -772,9 +828,14 @@ class _WritePipeline:
                 task.result()  # propagate failures
             else:
                 nbytes, t0, path = self.io_tasks.pop(task)
-                task.result()  # propagate failures
+                try:
+                    task.result()  # propagate failures
+                finally:
+                    # The staged buffer is released whether the write landed
+                    # or failed — credit on both paths (popped above, so no
+                    # other path can).
+                    self.budget.credit(nbytes)
                 self._record_task("io", t0, path, nbytes)
-                self.budget.credit(nbytes)
                 self.progress.note_written(nbytes)
                 self.progress.note_request_done()
         if done:
@@ -809,6 +870,7 @@ class _WritePipeline:
                 self._dispatch_staging()
                 self._report()
         except BaseException:
+            await self._abort_inflight()
             self._shutdown_executor(failed=True)
             raise
         finally:
@@ -901,8 +963,10 @@ class _WritePipeline:
                         exc_info=True,
                     )
         except BaseException:
-            # Error path: cancel queued staging/hash thunks so they don't
-            # run against a torn-down pipeline.
+            # Error path: cancel in-flight tasks (crediting their budget
+            # debits) and queued staging/hash thunks so nothing runs
+            # against a torn-down pipeline.
+            await self._abort_inflight()
             await self._reap_watchdog(watchdog_task)
             self._shutdown_executor(failed=True)
             raise
@@ -1023,6 +1087,13 @@ class PendingIOWork:
 
     def sync_complete(self, event_loop: asyncio.AbstractEventLoop) -> None:
         event_loop.run_until_complete(self.complete())
+
+    @property
+    def budget_balanced(self) -> bool:
+        """True when every memory-budget debit has been credited back.
+        Holds after a successful drain AND after an aborted one — the
+        chaos harness asserts it on every failure path."""
+        return self._pipeline.budget_balanced
 
     @property
     def drain_stats(self) -> Dict[str, float]:
@@ -1223,8 +1294,20 @@ async def execute_read_reqs(
                 budget,
             )
     except BaseException:
-        # Error path: queued consumer thunks must not run against a
-        # torn-down pipeline.
+        # Error path: cancel in-flight reads/consumes (crediting their
+        # budget debits) and queued consumer thunks — nothing may run
+        # against a torn-down pipeline.
+        inflight = list(io_tasks) + list(consume_tasks)
+        for task in inflight:
+            task.cancel()
+        if inflight:
+            await asyncio.gather(*inflight, return_exceptions=True)
+        for _req, cost, _t0 in io_tasks.values():
+            budget.credit(cost)
+        for cost, _t0, _path in consume_tasks.values():
+            budget.credit(cost)
+        io_tasks.clear()
+        consume_tasks.clear()
         pools.shutdown(cancel_queued=True)
         raise
     else:

@@ -66,7 +66,7 @@ from .manifest import (
     is_container_entry,
 )
 from .parallel.coordinator import Coordinator, get_coordinator
-from .parallel.store import LinearBarrier
+from .parallel.store import BarrierError, LinearBarrier
 from .partitioner import partition_write_reqs_with_assignment
 from .rng_state import RNGState
 from .scheduler import (
@@ -211,6 +211,82 @@ def _persist_op_artifact(
     )
 
 
+class CheckpointAbortedError(RuntimeError):
+    """A take failed mid-flight and the checkpoint was aborted — cleanly.
+
+    Raised on EVERY rank (the failing one and its peers, via the commit
+    barrier's error fan-out) within the barrier timeout, so no rank ever
+    hangs on a dead or failing peer. Structured attribution:
+
+    - ``rank``: the rank whose failure aborted the checkpoint (``None``
+      when unattributable — e.g. a peer died without reporting and the
+      barrier timed out);
+    - ``phase``: what that rank was doing (``"write"`` — staging + storage
+      drain, ``"commit"`` — the metadata barrier);
+    - ``detail``: the underlying error's text.
+
+    Invariants that hold when this is raised: ``.snapshot_metadata`` was
+    never written (the snapshot is invisible to readers; a previously
+    committed snapshot at another path is untouched), the scheduler's
+    memory budget has been fully credited back, and the pipeline pools are
+    shut down. Debris (temp files, data objects of the torn take) may
+    remain — ``Snapshot.gc`` reclaims it.
+
+    Subclasses RuntimeError: existing callers that catch RuntimeError from
+    ``take()``/``PendingSnapshot.wait()`` keep working.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        rank: Optional[int],
+        phase: Optional[str],
+        detail: str,
+    ) -> None:
+        self.path = path
+        self.rank = rank
+        self.phase = phase
+        self.detail = detail
+        who = f"rank {rank}" if rank is not None else "a peer rank"
+        doing = f" during {phase}" if phase else ""
+        super().__init__(
+            f"checkpoint to {path} aborted: {who} failed{doing}: {detail}"
+        )
+
+
+def _abort_exception(
+    path: str,
+    barrier: Optional[LinearBarrier],
+    rank: int,
+    phase: str,
+    e: BaseException,
+) -> BaseException:
+    """Turn a take failure into the exception to raise: report it through
+    the commit barrier (unblocking + failing every peer), prefer a peer's
+    earlier report for attribution, and wrap in
+    :class:`CheckpointAbortedError`. Non-Exception BaseExceptions
+    (KeyboardInterrupt, SystemExit) are reported but re-raised raw."""
+    telemetry.counter_add("snapshot.abort")
+    if isinstance(e, BarrierError):
+        # A peer already failed and fanned out through the barrier: name it.
+        return CheckpointAbortedError(path, e.rank, e.phase or phase, str(e))
+    if barrier is not None:
+        try:
+            barrier.report_error(
+                e if isinstance(e, Exception) else RuntimeError(repr(e)),
+                phase=phase,
+            )
+        except Exception:  # noqa: BLE001 - reporting is best-effort
+            pass
+    if not isinstance(e, Exception):
+        return e
+    if isinstance(e, TimeoutError):
+        # The barrier (or a store collective) timed out: some peer died
+        # without reporting. Unattributable, but still a structured abort.
+        return CheckpointAbortedError(path, None, phase, repr(e))
+    return CheckpointAbortedError(path, rank, phase, repr(e))
+
+
 class Snapshot:
     """A reference to a persisted snapshot at ``path``.
 
@@ -227,6 +303,12 @@ class Snapshot:
     # take/async_take/restore that had one (explicit ``_telemetry=`` or the
     # TORCHSNAPSHOT_TPU_TRACE knob). Diagnostics only; overwritten per op.
     last_telemetry: Optional["telemetry.Telemetry"] = None
+
+    # SPMD sync-commit sequence (the sync-take analogue of
+    # ``PendingSnapshot._seq``): every rank takes snapshots in the same
+    # order, so the counter is identical across ranks and keeps commit
+    # barrier ids unique when the same path is snapshotted twice.
+    _commit_seq = 0
 
     def __init__(self, path: str, coordinator: Optional[Coordinator] = None) -> None:
         self.path = path
@@ -259,11 +341,29 @@ class Snapshot:
         published as ``Snapshot.last_telemetry``."""
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
+        rank = coord.get_rank()
         tm, tm_prev = _begin_telemetry(_telemetry)
         try:
             plan = cls._plan_take(path, app_state, coord, replicated or [], base)
             event_loop = asyncio.new_event_loop()
             storage = url_to_storage_plugin_in_event_loop(plan.path, event_loop)
+            # Store-based commit barrier WITH error fan-out (the async path's
+            # LinearBarrier, now on the sync path too): a rank failing
+            # mid-write or mid-commit unblocks and fails every peer within
+            # the barrier timeout — structured CheckpointAbortedError
+            # everywhere, never a peer deadlocked on a dead rank. SPMD seq:
+            # every rank constructs sync takes in the same order, so the
+            # barrier id is unique per take even when one path repeats.
+            barrier = None
+            if coord.get_world_size() > 1:
+                Snapshot._commit_seq += 1
+                barrier = LinearBarrier(
+                    store=coord.store,
+                    barrier_id=f"commit/{Snapshot._commit_seq}/{plan.path}",
+                    rank=rank,
+                    world_size=coord.get_world_size(),
+                )
+            phase = "write"
             try:
                 pending_io_work, metadata = cls._take_impl(
                     plan=plan,
@@ -281,7 +381,7 @@ class Snapshot:
                 _persist_op_artifact(
                     storage,
                     event_loop,
-                    rank=coord.get_rank(),
+                    rank=rank,
                     world_size=coord.get_world_size(),
                     op="take",
                     tm=tm,
@@ -291,16 +391,28 @@ class Snapshot:
                     io_summary=pending_io_work.telemetry_io_summary(),
                 )
                 # Commit metadata only after ALL ranks finished writing data.
+                phase = "commit"
                 with telemetry.span("take.commit", cat="take"):
-                    coord.barrier()
-                    if coord.get_rank() == 0:
+                    if barrier is not None:
+                        barrier.arrive()
+                    if rank == 0:
                         cls._write_snapshot_metadata(
                             metadata, storage, event_loop
                         )
                     # ...and return only after the commit is visible:
                     # otherwise a non-zero rank could immediately open the
                     # path for restore and race rank 0's metadata write.
-                    coord.barrier()
+                    if barrier is not None:
+                        barrier.depart()
+                        # The depart doubles as a full-world rendezvous:
+                        # let the coordinator collect collective keys
+                        # posted before it.
+                        coord.note_external_barrier()
+            except BaseException as e:
+                aborted = _abort_exception(plan.path, barrier, rank, phase, e)
+                if aborted is e:
+                    raise
+                raise aborted from e
             finally:
                 storage.sync_close(event_loop)
                 event_loop.close()
@@ -1156,6 +1268,121 @@ class Snapshot:
             storage.sync_close(event_loop)
             event_loop.close()
 
+    # -------------------------------------------------------------------- gc
+    @classmethod
+    def gc(cls, path: str, dry_run: bool = True) -> Dict[str, Any]:
+        """Reclaim crash debris under ``path`` — uncommitted snapshot trees
+        and files a committed manifest does not reference.
+
+        ``path`` is either one snapshot root or a directory whose immediate
+        children are snapshot roots (the usual ``/checkpoints/step_N``
+        layout). For each committed snapshot (``.snapshot_metadata``
+        present) the kept set is: the metadata file, every storage object
+        the manifest references, their ``.ftab`` frame tables, the checksum
+        sidecars, and the ``.telemetry/`` artifacts; everything else —
+        ``*.tmp.*`` files from torn fs writes, data objects of a crashed
+        retake — is debris. A child tree with NO committed metadata is
+        debris in its entirety (the atomic-commit contract: without
+        ``.snapshot_metadata`` the tree is invisible to every reader).
+
+        Dry-run by default: returns the report without deleting. With
+        ``dry_run=False`` debris is deleted through the snapshot's own
+        storage plugin and empty directories are pruned (fs).
+
+        Single-rank, no collectives — but do NOT run it concurrently with a
+        take into the same tree: an in-flight take is indistinguishable
+        from a crashed one until it commits.
+
+        Returns ``{"committed": [prefixes], "uncommitted": [prefixes],
+        "keep": [paths], "remove": [paths], "removed": int,
+        "dry_run": bool}`` (paths relative to ``path``).
+        """
+        from .io_preparers.array import FRAME_TABLE_SUFFIX
+
+        event_loop = asyncio.new_event_loop()
+        storage = url_to_storage_plugin_in_event_loop(path, event_loop)
+        try:
+            with telemetry.span("gc.scan", cat="gc", path=path):
+                all_paths = event_loop.run_until_complete(
+                    storage.list_prefix("")
+                )
+                # Snapshot roots: ``path`` itself, or its immediate children.
+                if SNAPSHOT_METADATA_FNAME in all_paths:
+                    roots = [""]
+                else:
+                    roots = sorted(
+                        {p.partition("/")[0] for p in all_paths if "/" in p}
+                    )
+                committed: List[str] = []
+                uncommitted: List[str] = []
+                keep: Set[str] = set()
+                for root in roots:
+                    prefix = f"{root}/" if root else ""
+                    meta_path = f"{prefix}{SNAPSHOT_METADATA_FNAME}"
+                    if meta_path not in all_paths:
+                        uncommitted.append(root)
+                        continue
+                    committed.append(root)
+                    read_io = ReadIO(path=meta_path)
+                    storage.sync_read(read_io, event_loop)
+                    metadata = SnapshotMetadata.from_json(
+                        read_io.buf.getvalue().decode("utf-8")
+                    )
+                    keep.add(meta_path)
+                    for loc in _manifest_storage_locations(metadata.manifest):
+                        keep.add(f"{prefix}{loc}")
+                        keep.add(f"{prefix}{loc}{FRAME_TABLE_SUFFIX}")
+                    for r in range(metadata.world_size):
+                        keep.add(f"{prefix}{CHECKSUM_FILE_PREFIX}{r}")
+                    keep.update(
+                        p
+                        for p in all_paths
+                        if p.startswith(f"{prefix}.telemetry/")
+                    )
+                remove = sorted(p for p in all_paths if p not in keep)
+            telemetry.counter_add("gc.files_scanned", len(all_paths))
+            telemetry.counter_add("gc.files_debris", len(remove))
+            removed = 0
+            if not dry_run:
+                with telemetry.span(
+                    "gc.delete", cat="gc", path=path, files=len(remove)
+                ):
+
+                    async def delete_all() -> int:
+                        sem = asyncio.Semaphore(
+                            knobs.get_max_concurrent_io_for(storage)
+                        )
+                        done = 0
+
+                        async def delete_one(p: str) -> None:
+                            nonlocal done
+                            async with sem:
+                                try:
+                                    await storage.delete(p)
+                                    done += 1
+                                except FileNotFoundError:
+                                    done += 1  # already gone — goal reached
+                        await asyncio.gather(*(delete_one(p) for p in remove))
+                        return done
+
+                    if remove:
+                        removed = event_loop.run_until_complete(delete_all())
+                    # Even with no files to delete, a crashed take may have
+                    # left empty directory skeletons (fs): prune them.
+                    event_loop.run_until_complete(storage.prune_empty())
+                telemetry.counter_add("gc.files_removed", removed)
+            return {
+                "committed": committed,
+                "uncommitted": uncommitted,
+                "keep": sorted(keep & set(all_paths)),
+                "remove": remove,
+                "removed": removed,
+                "dry_run": dry_run,
+            }
+        finally:
+            storage.sync_close(event_loop)
+            event_loop.close()
+
     # -------------------------------------------------------------- metadata
     @property
     def metadata(self) -> SnapshotMetadata:
@@ -1617,7 +1844,19 @@ def _prepare_restore_one(
             def finalize_jax() -> None:
                 import jax
 
-                loaded[logical_path] = jax.device_put(target, live.sharding)
+                if live.sharding.is_fully_addressable:
+                    loaded[logical_path] = jax.device_put(target, live.sharding)
+                else:
+                    # device_put onto a multiprocess sharding runs a jitted
+                    # consistency collective (refused outright on the
+                    # multiprocess CPU backend); building the global array
+                    # shard-by-shard needs no collective on any backend —
+                    # every rank holds the full host target here.
+                    loaded[logical_path] = jax.make_array_from_callback(
+                        tuple(int(s) for s in entry.shape),
+                        live.sharding,
+                        lambda idx: target[idx],
+                    )
 
             return reqs, finalize_jax
         loaded[logical_path] = target
@@ -1708,6 +1947,7 @@ class PendingSnapshot:
         PendingSnapshot._seq += 1
         self._barrier_id = f"async_commit/{PendingSnapshot._seq}/{path}"
         self._exc: Optional[BaseException] = None
+        self._phase = "write"  # what the background thread is doing now
         self._done = threading.Event()
         self._thread = threading.Thread(
             target=self._complete_snapshot,
@@ -1733,6 +1973,7 @@ class PendingSnapshot:
             world_size=self._coord.get_world_size(),
         )
         try:
+            self._phase = "write"
             pending_io_work.sync_complete(event_loop)
             # Pre-barrier, like the checksum sidecars: every committed
             # snapshot carries every rank's artifact. Fail-open.
@@ -1746,6 +1987,7 @@ class PendingSnapshot:
                 phase_spans=self._phase_spans,
                 io_summary=pending_io_work.telemetry_io_summary(),
             )
+            self._phase = "commit"
             barrier.arrive()
             if rank == 0:
                 Snapshot._write_snapshot_metadata(self._metadata, storage, event_loop)
@@ -1754,8 +1996,12 @@ class PendingSnapshot:
             logger.error(
                 "Async snapshot failed on rank %d:\n%s", rank, traceback.format_exc()
             )
+            telemetry.counter_add("snapshot.abort")
             try:
-                barrier.report_error(e)
+                barrier.report_error(
+                    e if isinstance(e, Exception) else RuntimeError(repr(e)),
+                    phase=self._phase,
+                )
             except Exception:
                 pass
             self._exc = e
@@ -1771,9 +2017,24 @@ class PendingSnapshot:
     def wait(self) -> Snapshot:
         self._thread.join()
         if self._exc is not None:
-            raise RuntimeError(
-                f"Async snapshot to {self.path} failed"
-            ) from self._exc
+            e = self._exc
+            # Same structured abort as the sync path: peers' reports carry
+            # their rank + phase through the barrier; a barrier timeout
+            # (peer died without reporting) stays unattributed; everything
+            # else names THIS rank. RuntimeError subclass + original cause
+            # chained, so existing `except RuntimeError` callers and
+            # cause-inspecting tests keep working.
+            if isinstance(e, BarrierError):
+                raise CheckpointAbortedError(
+                    self.path, e.rank, e.phase or "commit", str(e)
+                ) from e
+            if isinstance(e, TimeoutError):
+                raise CheckpointAbortedError(
+                    self.path, None, self._phase, repr(e)
+                ) from e
+            raise CheckpointAbortedError(
+                self.path, self._coord.get_rank(), self._phase, repr(e)
+            ) from e
         snapshot = Snapshot(path=self.path, coordinator=self._coord)
         snapshot._metadata = self._metadata
         return snapshot

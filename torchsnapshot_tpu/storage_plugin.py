@@ -67,6 +67,21 @@ def write_telemetry_artifact(
 
 
 def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    plugin = _resolve_storage_plugin(url_path)
+    from .utils import knobs
+
+    if knobs.get_faults_spec():
+        # Deterministic fault injection (tests only): wrap EVERY plugin this
+        # process — and, since the env var is inherited, every child rank —
+        # constructs, so a single seeded spec drives faults across a whole
+        # fake pod. See faults.py / docs/robustness.md.
+        from .faults import maybe_wrap_with_faults
+
+        plugin = maybe_wrap_with_faults(plugin)
+    return plugin
+
+
+def _resolve_storage_plugin(url_path: str) -> StoragePlugin:
     if "://" in url_path:
         protocol, _, path = url_path.partition("://")
         if protocol == "":

@@ -11,6 +11,7 @@ import asyncio
 import logging
 import random
 import time
+from typing import Optional
 
 from .. import telemetry
 
@@ -44,19 +45,37 @@ class CollectiveProgress:
     def out_of_time(self) -> bool:
         return time.monotonic() - self._last > self.window_s
 
+    def remaining_s(self) -> float:
+        """Seconds until the window expires with no further activity —
+        the longest a retry loop should ever sleep before its give-up
+        check. Never negative."""
+        return max(0.0, self.window_s - (time.monotonic() - self._last))
 
-def backoff_s(attempt: int) -> float:
+
+def backoff_s(attempt: int, base_backoff_s: Optional[float] = None) -> float:
     """Jittered exponential backoff shared by every retry path. Reads the
-    module constants at call time so tests can shrink them."""
-    return min(MAX_BACKOFF_S, BASE_BACKOFF_S * (2**attempt)) * (
-        0.5 + random.random()
-    )
+    module constants at call time so tests can shrink them; an explicit
+    ``base_backoff_s`` (the fault plugin's knob-driven override) wins."""
+    base = BASE_BACKOFF_S if base_backoff_s is None else base_backoff_s
+    return min(MAX_BACKOFF_S, base * (2**attempt)) * (0.5 + random.random())
 
 
-async def retry_transient(run, is_transient, progress: CollectiveProgress, label: str):
+async def retry_transient(
+    run,
+    is_transient,
+    progress: CollectiveProgress,
+    label: str,
+    base_backoff_s: Optional[float] = None,
+):
     """``await run()`` with transient retry under the collective-progress
     window: op start/success count as activity; a total outage expires the
-    window, congestion that still makes progress does not."""
+    window, congestion that still makes progress does not.
+
+    Each backoff sleep is clamped to the window's remaining time (plus a
+    small epsilon so the post-sleep check lands past the deadline), and
+    ``out_of_time`` is re-checked after sleeping — a final exponential
+    sleep can therefore never overshoot the give-up deadline by more than
+    the epsilon, instead of by a full MAX_BACKOFF period."""
     attempt = 0
     progress.note_progress()
     while True:
@@ -66,7 +85,14 @@ async def retry_transient(run, is_transient, progress: CollectiveProgress, label
             if not is_transient(e) or progress.out_of_time():
                 raise
             attempt += 1
-            backoff = backoff_s(attempt)
+            # Clamp to the remaining window: sleeping past the deadline
+            # only delays the inevitable raise (other ops' progress during
+            # the sleep refreshes the window, and the post-sleep re-check
+            # below honors that).
+            backoff = min(
+                backoff_s(attempt, base_backoff_s),
+                progress.remaining_s() + 0.05,
+            )
             # Observability for flaky links: how often the plugins retried
             # and how long they slept doing it (per-plugin via the label).
             telemetry.counter_add(f"cloud_retry.{label.lower()}.retries")
@@ -82,6 +108,11 @@ async def retry_transient(run, is_transient, progress: CollectiveProgress, label
                 e,
             )
             await asyncio.sleep(backoff)
+            if progress.out_of_time():
+                # The window expired during the sleep (and nothing else
+                # made progress meanwhile): surface the last transient
+                # error now rather than burning one more attempt.
+                raise
         else:
             progress.note_progress()
             return result

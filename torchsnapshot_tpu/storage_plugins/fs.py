@@ -18,12 +18,13 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import errno
 import os
 import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional, Set
+from typing import List, Optional, Set
 
 try:
     import aiofiles
@@ -36,8 +37,32 @@ except ImportError:  # pragma: no cover - environment-dependent
 from .. import native, telemetry
 from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
 from ..utils import knobs
+from .cloud_retry import CollectiveProgress, retry_transient
 
 _DIRECT_ALIGN = 4096  # matches the native engine's kAlign
+
+# Local errno values that are plausibly transient on NETWORK filesystems
+# (NFS/SMB-mounted checkpoint dirs): a stale handle after a server failover,
+# a timed-out round-trip, a briefly-busy inode. On genuinely local disks
+# these are rare enough that a couple of retries cost nothing. Permanent
+# conditions (ENOSPC, EACCES, EROFS, ENOENT...) are deliberately absent —
+# retrying those just delays a real error.
+_TRANSIENT_ERRNOS = frozenset(
+    e
+    for e in (
+        errno.ESTALE,
+        errno.ETIMEDOUT,
+        errno.EAGAIN,
+        errno.EBUSY,
+        errno.EINTR,
+        getattr(errno, "EREMOTEIO", None),
+    )
+    if e is not None
+)
+
+
+def _is_transient_oserror(e: Exception) -> bool:
+    return isinstance(e, OSError) and e.errno in _TRANSIENT_ERRNOS
 
 
 class _FSWriteStream(StorageWriteStream):
@@ -56,6 +81,12 @@ class _FSWriteStream(StorageWriteStream):
         plugin._ensure_parent(abs_path)
         self._abs_path = abs_path
         self._tmp_path = f"{abs_path}.tmp.{uuid.uuid4().hex[:8]}"
+        # Create the temp file eagerly: the stream's crash window opens HERE,
+        # not at the first sector-aligned append (small appends live in the
+        # Python carry until alignment) — a crash mid-stream must leave the
+        # temp file for Snapshot.gc to find, and abort() must always have a
+        # file to unlink. Metadata-op cost only, like _ensure_parent above.
+        open(self._tmp_path, "wb").close()
         self._offset = 0  # durably written bytes (sector-aligned in native mode)
         self._carry = bytearray()  # unaligned tail awaiting the next append
         self._file = None  # buffered-mode persistent file object
@@ -195,6 +226,11 @@ class FSStoragePlugin(StoragePlugin):
         # derives the local world size, and the stream cap must reflect it.
         self._direct_sem: Optional[threading.Semaphore] = None
         self._sem_lock = threading.Lock()
+        # Transient local OSErrors (stale NFS handles, timed-out round-trips
+        # — see _TRANSIENT_ERRNOS) retry under the same collective-progress
+        # policy the cloud plugins use: a network-filesystem hiccup behaves
+        # like cloud throttling, not like a permanent failure.
+        self._progress = CollectiveProgress()
 
     @property
     def _native(self):
@@ -244,7 +280,15 @@ class FSStoragePlugin(StoragePlugin):
             path=write_io.path,
             nbytes=nbytes,
         ):
-            await self._write_inner(write_io, nbytes)
+            # Retry-safe: every attempt writes a FRESH temp file and the
+            # error path below unlinks it, so a retried write can neither
+            # observe nor leave a prior attempt's partial bytes.
+            await retry_transient(
+                lambda: self._write_inner(write_io, nbytes),
+                _is_transient_oserror,
+                self._progress,
+                "fs",
+            )
         telemetry.counter_add("storage.fs.write_bytes", nbytes)
 
     async def _write_inner(self, write_io: WriteIO, nbytes: int) -> None:
@@ -356,7 +400,16 @@ class FSStoragePlugin(StoragePlugin):
             plugin="fs",
             path=read_io.path,
         ) as sp:
-            await self._read_inner(read_io)
+            async def attempt() -> None:
+                # A retried read must not append to a buffer the failed
+                # attempt already partially filled.
+                read_io.buf.seek(0)
+                read_io.buf.truncate(0)
+                await self._read_inner(read_io)
+
+            await retry_transient(
+                attempt, _is_transient_oserror, self._progress, "fs"
+            )
             nbytes = read_io.buf.getbuffer().nbytes
             sp.set_attrs(nbytes=nbytes)
         telemetry.counter_add("storage.fs.read_bytes", nbytes)
@@ -422,6 +475,53 @@ class FSStoragePlugin(StoragePlugin):
     async def delete(self, path: str) -> None:
         await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), os.remove, os.path.join(self.root, path)
+        )
+
+    async def list_prefix(self, prefix: str) -> List[str]:
+        """All file paths under ``root/prefix``, relative to ``root``
+        (including crash debris like ``*.tmp.*`` files — that is the point:
+        ``Snapshot.gc`` reclaims what a manifest walk can't see)."""
+
+        def work() -> List[str]:
+            base = os.path.join(self.root, prefix) if prefix else self.root
+            out: List[str] = []
+            if not os.path.isdir(base):
+                if os.path.isfile(base):
+                    out.append(os.path.relpath(base, self.root))
+                return out
+            for dirpath, _, filenames in os.walk(base):
+                for name in filenames:
+                    out.append(
+                        os.path.relpath(os.path.join(dirpath, name), self.root)
+                    )
+            return sorted(out)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), work
+        )
+
+    async def prune_empty(self) -> None:
+        """Remove directories left empty by deletions (bottom-up), so a
+        gc'd snapshot tree doesn't keep its skeleton of empty dirs. The
+        root itself is kept. Invalidates the mkdir cache — a pruned dir
+        must be re-creatable by a later write."""
+
+        def work() -> None:
+            for dirpath, dirnames, filenames in os.walk(self.root, topdown=False):
+                if dirpath == self.root or filenames or dirnames:
+                    # os.walk(topdown=False) visits children first, but the
+                    # dirnames list was computed before they were pruned —
+                    # re-check emptiness on disk.
+                    if dirpath != self.root and not os.listdir(dirpath):
+                        with contextlib.suppress(OSError):
+                            os.rmdir(dirpath)
+                    continue
+                with contextlib.suppress(OSError):
+                    os.rmdir(dirpath)
+            self._dir_cache.clear()
+
+        await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), work
         )
 
     async def close(self) -> None:

@@ -234,6 +234,18 @@ class GCSStoragePlugin(StoragePlugin):
                 raise FileNotFoundError(path) from e
             raise
 
+    async def list_prefix(self, prefix: str) -> list:
+        full = self._blob_path(prefix) if prefix else self.prefix
+        strip = f"{self.prefix}/" if self.prefix else ""
+
+        def work() -> list:
+            blobs = self._client.list_blobs(self._bucket.name, prefix=full)
+            return sorted(
+                b.name[len(strip):] for b in blobs if b.name.startswith(strip)
+            )
+
+        return await self._retrying(work)
+
     async def link_in(self, src_abs_path: str, path: str) -> bool:
         """Server-side copy from a base snapshot (incremental takes): a GCS
         rewrite moves no bytes through this host. ``src_abs_path`` is the

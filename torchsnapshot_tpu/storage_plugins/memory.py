@@ -7,7 +7,7 @@ tests of the scheduler/batcher/preparers hermetic and fast.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List
 
 from .. import telemetry
 from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
@@ -81,3 +81,6 @@ class MemoryStoragePlugin(StoragePlugin):
             del self.objects[path]
         except KeyError:
             raise FileNotFoundError(path) from None
+
+    async def list_prefix(self, prefix: str) -> List[str]:
+        return sorted(p for p in self.objects if p.startswith(prefix))

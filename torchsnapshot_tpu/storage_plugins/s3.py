@@ -378,6 +378,29 @@ class S3StoragePlugin(StoragePlugin):
             lambda: client.delete_object(Bucket=self.bucket, Key=self._key(path))
         )
 
+    async def list_prefix(self, prefix: str) -> list:
+        client = await self._get_client()
+        full = self._key(prefix) if prefix else self.prefix
+        strip = f"{self.prefix}/" if self.prefix else ""
+
+        async def list_all() -> list:
+            out = []
+            token = None
+            while True:
+                kwargs = {"Bucket": self.bucket, "Prefix": full}
+                if token:
+                    kwargs["ContinuationToken"] = token
+                resp = await client.list_objects_v2(**kwargs)
+                for obj in resp.get("Contents", []) or []:
+                    key = obj["Key"]
+                    if key.startswith(strip):
+                        out.append(key[len(strip):])
+                if not resp.get("IsTruncated"):
+                    return sorted(out)
+                token = resp.get("NextContinuationToken")
+
+        return await self._retrying(list_all)
+
     async def link_in(self, src_abs_path: str, path: str) -> bool:
         """Server-side CopyObject from a base snapshot (incremental takes):
         no bytes move through this host. ``src_abs_path`` is the base

@@ -236,10 +236,20 @@ def run_with_processes(
             else:
                 failures.pop(rank, None)
     finally:
+        # Reap promptly on every exit path. On success every rank has
+        # already reported (only rank 0's bounded store-drain linger may
+        # remain); on failure/timeout a hung child must not stall teardown
+        # for 30 s per process — escalate join -> terminate -> kill.
         for p in procs:
-            p.join(timeout=30)
+            p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        for p in procs:
+            if p.is_alive():
+                p.join(timeout=10)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
     if failures:
         msgs = "\n".join(f"--- rank {r} ---\n{e}" for r, e in failures.items())
         raise RuntimeError(f"{len(failures)}/{nproc} workers failed:\n{msgs}")

@@ -681,6 +681,25 @@ def set_coordinator_env(store_addr: str, rank: int, world_size: int) -> None:
     os.environ[_ENV_WORLD_SIZE] = str(world_size)
 
 
+_ENV_FAULTS = "TORCHSNAPSHOT_TPU_FAULTS"
+
+
+def get_faults_spec() -> Optional[str]:
+    """Deterministic storage-fault injection spec (see ``faults.py`` and
+    ``docs/robustness.md`` for the grammar). When set, every storage plugin
+    ``url_to_storage_plugin`` constructs — in this process and in child
+    ranks, since the env var is inherited — is wrapped in a
+    :class:`~torchsnapshot_tpu.faults.FaultyStoragePlugin` that injects
+    transient/permanent failures, torn writes, latency stalls, and
+    process-kill crash points per the seeded spec. Test-only: leave unset
+    in production jobs."""
+    return os.environ.get(_ENV_FAULTS) or None
+
+
+def override_faults(spec: str):
+    return _override_env(_ENV_FAULTS, spec)
+
+
 def get_launcher_drain_s() -> float:
     """How long ``test_utils.run_with_processes``'s rank 0 lingers after its
     own work so peers still inside a final store op aren't connection-reset
