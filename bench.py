@@ -68,6 +68,58 @@ def build_params(total_gb: float, seed: int = 0):
     return params, nbytes
 
 
+def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dict:
+    """Fail-soft regression gate: compare this run's drain wall and
+    drain_vs_link against the BEST prior BENCH_r0*.json taken on the same
+    workload (matched by detail.size_gb). Never raises and never aborts the
+    bench — the link itself drifts run to run — but a >10% drain-wall
+    regression or a >0.05 drain_vs_link drop is logged loudly and recorded
+    in the emitted JSON so the trajectory can't regress silently."""
+    import glob
+
+    priors = []
+    for path in sorted(glob.glob("BENCH_r0*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            det = (rec.get("parsed") or {}).get("detail") or {}
+            if abs(float(det.get("size_gb", -1.0)) - size_gb) > 0.05:
+                continue  # different workload: not comparable
+            priors.append(
+                (
+                    path,
+                    float(det["background_drain_s"]),
+                    float(det.get("drain_vs_link", 0.0)),
+                )
+            )
+        except Exception:
+            continue  # unreadable/alien artifact: skip, never fail
+    if not priors:
+        return {"status": "no_prior", "priors": 0}
+    best_drain_s = min(p[1] for p in priors)
+    best_vs_link = max(p[2] for p in priors)
+    problems = []
+    if drain_s > best_drain_s * 1.10:
+        problems.append(
+            f"drain wall {drain_s:.2f}s is >10% over the best prior "
+            f"{best_drain_s:.2f}s"
+        )
+    if drain_vs_link < best_vs_link - 0.05:
+        problems.append(
+            f"drain_vs_link {drain_vs_link:.2f} dropped more than 0.05 "
+            f"below the best prior {best_vs_link:.2f}"
+        )
+    for p in problems:
+        log(f"WARNING: bench regression gate: {p}")
+    return {
+        "status": "regression" if problems else "ok",
+        "priors": len(priors),
+        "best_prior_drain_s": round(best_drain_s, 2),
+        "best_prior_drain_vs_link": round(best_vs_link, 2),
+        "problems": problems,
+    }
+
+
 def measure_naive_save(params_slice, root: str):
     """torch.save-equivalent: blocking device_get of everything, then one
     buffered single-stream pickle write (what the reference benchmarks
@@ -178,6 +230,20 @@ def main() -> None:
         drain_gbps = gb / drain_s
         drain_vs_link = drain_gbps / link_gbps
         log(f"background drain (D2H + storage I/O): {drain_s:.2f}s {drain_stats}")
+        # stage_busy decomposed (the PR-6 attribution): where staging time
+        # actually went. With parallel lanes the sub-streams overlap, so
+        # their sum can exceed stage_busy — each is that sub-stream's own
+        # busy time.
+        stage_breakdown = {
+            k: drain_stats.get(k, 0.0)
+            for k in ("stage_d2h_s", "stage_serialize_s", "stage_hash_s")
+        }
+        log(
+            f"stage breakdown: d2h {stage_breakdown['stage_d2h_s']:.2f}s, "
+            f"serialize {stage_breakdown['stage_serialize_s']:.2f}s, "
+            f"hash {stage_breakdown['stage_hash_s']:.2f}s "
+            f"(stage_busy {drain_stats.get('stage_busy_s', 0.0):.2f}s)"
+        )
         log(
             f"drain rate {drain_gbps:.4f} GB/s vs link {link_gbps:.4f} GB/s "
             f"(probes {link_before:.4f}/{link_after:.4f}) -> "
@@ -426,6 +492,12 @@ def main() -> None:
         except Exception as e:  # diagnostics must never fail the bench
             log(f"WARNING: telemetry artifact aggregation failed: {e!r}")
 
+        # ---- fail-soft regression gate vs the best prior round on this
+        # workload (same size_gb): drain wall and drain_vs_link must not
+        # silently regress the way rounds 2→5 did.
+        gate = regression_gate(round(gb, 2), drain_s, drain_vs_link)
+        log(f"regression gate: {gate}")
+
         # ---- restore bit-exactness via random access into the async ckpt
         snap = Snapshot(os.path.join(root, "ckpt_async"))
         probe = list(params)[-1]
@@ -457,6 +529,8 @@ def main() -> None:
                         "drain_vs_link": round(drain_vs_link, 2),
                         "stall_phases_s": stall_phases,
                         "drain_stats_s": drain_stats,
+                        "stage_breakdown_s": stage_breakdown,
+                        "regression_gate": gate,
                         "sync_drain_stats_s": sync_drains,
                         "target_stall_s": 5.0,
                         "stream_ab": stream_ab,

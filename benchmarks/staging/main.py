@@ -1,0 +1,181 @@
+"""Staging-only micro-bench: _WritePipeline overhead without a device.
+
+The r02→r05 drain regression (32s → 55s on the same 1.11 GB workload) hid
+inside ``stage_busy`` — a single opaque number polluted by TPU/link variance.
+This harness makes staging overhead measurable in isolation, bisect-style:
+
+- **synthetic host buffers** (numpy, no device, no D2H variance): a
+  ``np.asarray`` on a host array is free, so the measured wall is purely the
+  pipeline's own machinery — serialization, hashing, chunk plumbing, budget
+  accounting, event-loop dispatch;
+- **a null storage sink** (appends/writes discard after a length probe): no
+  disk, no page cache, no O_DIRECT alignment — ``io_busy`` collapses to the
+  call overhead, so ``stage_busy`` is the whole story;
+- **an ablation matrix** over the staging features that have historically
+  eaten drain time: streaming on/off, checksums on/off, dedup digests
+  on/off. A regression bisects by diffing configs between two commits.
+
+Reported per config: wall seconds, GB/s through staging, and the
+``stage_d2h_s``/``stage_serialize_s``/``stage_hash_s`` decomposition. One
+JSON line on stdout; progress on stderr.
+
+  python benchmarks/staging/main.py                 # default ~0.5 GB
+  STAGING_BENCH_MB=64 python benchmarks/staging/main.py   # quick smoke
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer  # noqa: E402
+from torchsnapshot_tpu.io_types import (  # noqa: E402
+    ReadIO,
+    StoragePlugin,
+    StorageWriteStream,
+    WriteIO,
+)
+from torchsnapshot_tpu.scheduler import execute_write_reqs  # noqa: E402
+from torchsnapshot_tpu.utils import knobs  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+class _NullWriteStream(StorageWriteStream):
+    def __init__(self, plugin: "NullStoragePlugin") -> None:
+        self._plugin = plugin
+
+    async def append(self, buf) -> None:
+        self._plugin.bytes_sunk += memoryview(buf).nbytes
+
+    async def commit(self) -> None:
+        pass
+
+    async def abort(self) -> None:
+        pass
+
+
+class NullStoragePlugin(StoragePlugin):
+    """Discards every byte after a length probe: the staging stream runs
+    against a zero-cost drain, so the pipeline's wall time IS staging."""
+
+    supports_streaming = True
+
+    def __init__(self) -> None:
+        self.bytes_sunk = 0
+
+    async def write(self, write_io: WriteIO) -> None:
+        self.bytes_sunk += memoryview(write_io.buf).nbytes
+
+    async def write_stream(self, path: str) -> StorageWriteStream:
+        return _NullWriteStream(self)
+
+    async def read(self, read_io: ReadIO) -> None:
+        raise FileNotFoundError(read_io.path)
+
+    async def delete(self, path: str) -> None:
+        pass  # idempotent: nothing is ever stored
+
+
+def build_host_state(total_mb: int, arrays: int, seed: int = 0):
+    """``arrays`` float32 host arrays summing to ~total_mb MB."""
+    rng = np.random.default_rng(seed)
+    per = max(1, total_mb // arrays)
+    rows = max(2, per * 1024 * 1024 // (1024 * 4))
+    return [
+        rng.standard_normal((rows, 1024)).astype(np.float32)
+        for _ in range(arrays)
+    ]
+
+
+def run_config(
+    arrs, stream: bool, checksums: bool, dedup: bool
+) -> dict:
+    storage = NullStoragePlugin()
+    reqs = []
+    for i, a in enumerate(arrs):
+        _entry, sub = ArrayIOPreparer.prepare_write(f"obj_{i}", a)
+        reqs.extend(sub)
+    total = sum(a.nbytes for a in arrs)
+
+    async def go():
+        pending = await execute_write_reqs(
+            reqs, storage, memory_budget_bytes=2**33, rank=0
+        )
+        await pending.complete()
+        return pending
+
+    loop = asyncio.new_event_loop()
+    try:
+        with knobs.override_stream_writes(stream), \
+                knobs.override_checksums(checksums), \
+                knobs.override_dedup_digests(dedup):
+            t0 = time.perf_counter()
+            pending = loop.run_until_complete(go())
+            wall = time.perf_counter() - t0
+    finally:
+        loop.close()
+    assert storage.bytes_sunk >= total, (storage.bytes_sunk, total)
+    stats = pending.pipeline_stats
+    return {
+        "wall_s": round(wall, 4),
+        "gbps": round(total / 1e9 / wall, 3),
+        "stage_busy_s": round(stats.get("stage_busy_s", 0.0), 4),
+        "stage_d2h_s": round(stats.get("stage_d2h_s", 0.0), 4),
+        "stage_serialize_s": round(stats.get("stage_serialize_s", 0.0), 4),
+        "stage_hash_s": round(stats.get("stage_hash_s", 0.0), 4),
+    }
+
+
+def main() -> None:
+    total_mb = int(os.environ.get("STAGING_BENCH_MB", "512"))
+    arrays = int(os.environ.get("STAGING_BENCH_ARRAYS", "8"))
+    arrs = build_host_state(total_mb, arrays)
+    total_gb = sum(a.nbytes for a in arrs) / 1e9
+    log(f"staging micro-bench: {total_gb:.2f} GB across {arrays} host arrays")
+
+    # The ablation matrix: diffing rows bisects which staging feature a
+    # regression lives in. "full" is the production default path.
+    matrix = {
+        "full": dict(stream=True, checksums=True, dedup=True),
+        "no_dedup_sha": dict(stream=True, checksums=True, dedup=False),
+        "no_digests": dict(stream=True, checksums=False, dedup=False),
+        "no_stream": dict(stream=False, checksums=True, dedup=True),
+    }
+    results = {}
+    for name, cfg in matrix.items():
+        results[name] = run_config(arrs, **cfg)
+        log(f"  {name}: {results[name]}")
+
+    full, bare = results["full"], results["no_digests"]
+    print(
+        json.dumps(
+            {
+                "metric": "staging_overhead_gbps",
+                "value": results["full"]["gbps"],
+                "unit": "GB/s",
+                "detail": {
+                    "size_gb": round(total_gb, 3),
+                    "arrays": arrays,
+                    "configs": results,
+                    # The hash satellite's measurable delta: staging rate
+                    # with vs without the digest pipeline.
+                    "hash_cost_s": round(
+                        max(0.0, full["wall_s"] - bare["wall_s"]), 4
+                    ),
+                    "env": {"knobs": knobs.env_fingerprint()},
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

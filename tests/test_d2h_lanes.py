@@ -1,0 +1,408 @@
+"""Parallel D2H lanes + zero-copy RAW staging + stage-time attribution.
+
+The PR-6 staging saturation work: TransferLanes window accounting, the
+lane-driven chunk stream's bit-exactness against the whole-buffer path
+(payload, ``.ftab``, sidecar digests) across dtypes and layouts, the
+budget high-water bound with look-ahead in flight, abort-path budget
+balance, and the ``stage.d2h``/``stage.serialize``/``stage.hash``
+decomposition in drain stats and persisted telemetry artifacts.
+"""
+
+import asyncio
+import hashlib
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, d2h
+from torchsnapshot_tpu.io_preparers.array import ArrayIOPreparer
+from torchsnapshot_tpu.scheduler import _WritePipeline, execute_write_reqs
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+from torchsnapshot_tpu.utils import knobs
+
+try:
+    import ml_dtypes
+except ImportError:  # pragma: no cover - ships with jax
+    ml_dtypes = None
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------ TransferLanes
+
+
+def test_lane_window_admission_and_release() -> None:
+    lanes = d2h.TransferLanes(lanes=2, window_bytes=100)
+    debits, credits = [], []
+    lanes.bind_budget(debits.append, credits.append, headroom=lambda: 10**9)
+    assert lanes.try_admit(60)
+    assert lanes.try_admit(40)
+    assert not lanes.try_admit(1)  # window full
+    assert lanes.try_admit(50, force=True)  # forced over-admission
+    assert lanes.outstanding_bytes == 150
+    assert lanes.window_hwm == 150
+    lanes.release(60)
+    assert lanes.try_admit(10)
+    lanes.release(40)
+    lanes.release(50)
+    lanes.release(10)
+    assert lanes.outstanding_bytes == 0
+    assert sum(debits) == sum(credits) == 160  # budget saw every byte
+
+
+def test_lane_window_respects_budget_headroom() -> None:
+    lanes = d2h.TransferLanes(lanes=1, window_bytes=10**9)
+    lanes.bind_budget(lambda n: None, lambda n: None, headroom=lambda: 50)
+    assert not lanes.try_admit(100)  # window huge, but no budget headroom
+    assert lanes.try_admit(100, force=True)  # first-chunk escape hatch
+    assert lanes.release_all() == 100
+
+
+def test_lane_release_all_sweeps_outstanding() -> None:
+    lanes = d2h.TransferLanes(lanes=1, window_bytes=1000)
+    credited = []
+    lanes.bind_budget(lambda n: None, credited.append)
+    lanes.try_admit(300)
+    lanes.try_admit(200)
+    assert lanes.release_all() == 500
+    assert credited == [500]
+    assert lanes.release_all() == 0  # idempotent
+
+
+def test_d2h_knobs() -> None:
+    assert knobs.get_d2h_lanes() >= 1
+    assert knobs.get_d2h_window_bytes() >= 0
+    with knobs.override_d2h_lanes(7):
+        assert knobs.get_d2h_lanes() == 7
+    with knobs.override_d2h_window_bytes(4096):
+        assert knobs.get_d2h_window_bytes() == 4096
+
+
+# --------------------------------------------------- zero-copy RAW staging
+
+
+def test_raw_stage_buffer_is_zero_copy_view() -> None:
+    """A RAW staged buffer is a memoryview over the host array's own bytes
+    — no serialization pass, no intermediate bytes()."""
+    arr = np.arange(1024, dtype=np.float32)
+    _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+    buf = _run(reqs[0].buffer_stager.stage_buffer())
+    assert isinstance(buf, memoryview)
+    assert np.shares_memory(np.frombuffer(buf, dtype=np.uint8), arr)
+
+
+def _dtype_cases():
+    cases = [np.dtype(np.float32)]
+    if ml_dtypes is not None:
+        cases.append(np.dtype(ml_dtypes.bfloat16))
+        cases.append(np.dtype(ml_dtypes.int4))
+    return cases
+
+
+@pytest.mark.parametrize("dtype", _dtype_cases(), ids=lambda d: d.name)
+@pytest.mark.parametrize("contiguous", [True, False])
+def test_zero_copy_raw_bit_exact_vs_whole_buffer(dtype, contiguous) -> None:
+    """The streamed zero-copy RAW path and the whole-buffer path produce
+    byte-identical objects and sidecar digests for every RAW dtype, from
+    contiguous AND non-contiguous sources."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 7, size=(64, 48)).astype(dtype)
+    arr = base if contiguous else base.T.copy().T  # F-order, same values
+    if not contiguous:
+        assert not arr.flags["C_CONTIGUOUS"]
+
+    def take(stream: bool):
+        storage = MemoryStoragePlugin()
+        _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+        async def go():
+            with knobs.override_stream_writes(stream), \
+                    knobs.override_stream_chunk_bytes(1024), \
+                    knobs.override_dedup_digests(True):
+                pending = await execute_write_reqs(
+                    reqs, storage, memory_budget_bytes=10**9, rank=0
+                )
+                await pending.complete()
+
+        _run(go())
+        return storage.objects
+
+    whole = take(stream=False)
+    streamed = take(stream=True)
+    assert whole.keys() == streamed.keys()
+    assert whole["obj"] == streamed["obj"]
+    # Sidecar digests (crc32, size, sha256) match between the paths and
+    # match an independent whole-object hash.
+    wc, sc = (json.loads(side[".checksums.0"]) for side in (whole, streamed))
+    assert wc == sc
+    crc, size, sha = wc["obj"]
+    assert crc == zlib.crc32(whole["obj"])
+    assert size == len(whole["obj"])
+    assert sha == hashlib.sha256(whole["obj"]).hexdigest()
+
+
+def test_zero_copy_framed_compressed_bit_exact_with_ftab() -> None:
+    """Framed-zlib entries stream bit-exactly too: payload AND the ``.ftab``
+    side object equal the whole-buffer path's."""
+    arr = (np.arange(96 * 64, dtype=np.float32) % 17).reshape(96, 64)
+
+    def take(stream: bool):
+        storage = MemoryStoragePlugin()
+        with knobs.override_compression("zlib"), \
+                knobs.override_compression_frame_bytes(4096):
+            _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+            async def go():
+                with knobs.override_stream_writes(stream), \
+                        knobs.override_stream_chunk_bytes(2048):
+                    pending = await execute_write_reqs(
+                        reqs, storage, memory_budget_bytes=10**9, rank=0
+                    )
+                    await pending.complete()
+
+            _run(go())
+        return storage.objects
+
+    whole = take(stream=False)
+    streamed = take(stream=True)
+    assert whole["obj"] == streamed["obj"]
+    assert json.loads(whole["obj.ftab"]) == json.loads(streamed["obj.ftab"])
+
+
+# ------------------------------------------ lanes through the write pipeline
+
+
+def _jax_app(rows=512, cols=256, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    arr = jax.random.normal(jax.random.PRNGKey(seed), (rows, cols), jnp.float32)
+    jax.block_until_ready(arr)
+    return arr
+
+
+def test_lane_streamed_jax_take_bit_exact_and_window_used() -> None:
+    """A jax array streamed under the lanes lands bit-exact against the
+    lane-less whole-buffer path, and the look-ahead window actually
+    engaged (transfers resolved ahead of consumption)."""
+    arr = _jax_app()
+    expected = np.asarray(arr).tobytes()
+
+    storage = MemoryStoragePlugin()
+    _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+    async def go():
+        await pipeline.run_until_staged()
+        await pipeline.run_to_completion()
+
+    # Knobs (incl. the lane window) resolve at pipeline construction.
+    with knobs.override_stream_writes(True), \
+            knobs.override_stream_chunk_bytes(64 * 1024), \
+            knobs.override_d2h_window_bytes(128 * 1024):
+        pipeline = _WritePipeline(
+            reqs, storage, memory_budget_bytes=10**9, rank=0
+        )
+        _run(go())
+    assert storage.objects["obj"] == expected
+    # The stream released everything it admitted; look-ahead happened.
+    lanes = pipeline._staging_ctx.lanes
+    assert lanes.outstanding_bytes == 0
+    assert lanes.window_hwm > 0
+    assert pipeline.budget_balanced
+
+
+def test_budget_hwm_bounded_by_window_plus_stream_depth() -> None:
+    """With lanes in flight, the budget high-water mark stays ~(window +
+    stream depth) — far below the array's full size."""
+    chunk = 16 * 1024
+    inflight = 2
+    window = 2 * chunk
+    arr = _jax_app(rows=2048, cols=256)  # 2 MB >> the bound below
+
+    storage = MemoryStoragePlugin()
+    _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+    async def go():
+        await pipeline.run_until_staged()
+        await pipeline.run_to_completion()
+
+    with knobs.override_stream_writes(True), \
+            knobs.override_stream_chunk_bytes(chunk), \
+            knobs.override_stream_inflight(inflight), \
+            knobs.override_d2h_window_bytes(window), \
+            knobs.override_d2h_lanes(2):
+        pipeline = _WritePipeline(
+            reqs, storage, memory_budget_bytes=10**9, rank=0
+        )
+        _run(go())
+    full = np.asarray(arr).nbytes
+    # window (look-ahead) + inflight chunks queued + the chunk being staged
+    # + the chunk being appended + estimate drift.
+    bound = window + (inflight + 3) * chunk
+    assert pipeline.budget.high_water_bytes <= bound, (
+        pipeline.budget.high_water_bytes, bound
+    )
+    assert pipeline.budget.high_water_bytes < full // 4
+    assert pipeline.budget_balanced
+    assert storage.objects["obj"] == np.asarray(arr).tobytes()
+
+
+def test_mid_drain_abort_with_lanes_in_flight_credits_every_debit() -> None:
+    """A storage append that explodes mid-stream, with lane look-ahead in
+    flight: the failure propagates, no partial object remains, and every
+    budget debit — per-chunk stream debits AND lane-window admissions — is
+    credited back."""
+
+    class FailingAppendStorage(MemoryStoragePlugin):
+        async def write_stream(self, path):
+            inner = await super().write_stream(path)
+
+            class _Failing:
+                appended = 0
+
+                async def append(self, buf):
+                    _Failing.appended += 1
+                    if _Failing.appended > 2:
+                        raise OSError("append exploded")
+                    await inner.append(buf)
+
+                async def commit(self):
+                    await inner.commit()
+
+                async def abort(self):
+                    await inner.abort()
+
+            return _Failing()
+
+    arr = _jax_app(rows=1024, cols=256)
+    storage = FailingAppendStorage()
+    _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+    async def go():
+        await asyncio.wait_for(pipeline.run_until_staged(), timeout=30)
+
+    with knobs.override_stream_writes(True), \
+            knobs.override_stream_chunk_bytes(16 * 1024), \
+            knobs.override_d2h_window_bytes(64 * 1024):
+        pipeline = _WritePipeline(
+            reqs, storage, memory_budget_bytes=10**9, rank=0
+        )
+        with pytest.raises(OSError, match="append exploded"):
+            _run(go())
+    assert "obj" not in storage.objects
+    assert pipeline.budget_balanced, (
+        pipeline.budget.available, pipeline.budget.total
+    )
+    assert pipeline._staging_ctx.lanes.outstanding_bytes == 0
+
+
+# --------------------------------------------------- stage-time attribution
+
+
+def test_stage_substreams_in_drain_stats_and_artifact(tmp_path) -> None:
+    """stage_d2h_s / stage_serialize_s / stage_hash_s appear in the drain
+    stats and in the persisted telemetry artifact (scalars + merged
+    sub-stream intervals)."""
+    import jax
+    import jax.numpy as jnp
+
+    arrs = {
+        f"a{i}": jax.random.normal(jax.random.PRNGKey(i), (128, 64), jnp.float32)
+        for i in range(3)
+    }
+    pending = Snapshot.async_take(str(tmp_path / "ck"), {"m": StateDict(**arrs)})
+    pending.wait()
+    stats = pending.drain_stats
+    for k in ("stage_d2h_s", "stage_serialize_s", "stage_hash_s"):
+        assert k in stats and stats[k] >= 0
+    # The D2H and hash sub-streams must have actually recorded something
+    # for device-backed state with checksums on.
+    assert stats["stage_d2h_s"] > 0
+    assert stats["stage_hash_s"] > 0
+
+    art = json.loads((tmp_path / "ck" / ".telemetry" / "rank_0.json").read_text())
+    for k in ("stage_d2h_s", "stage_serialize_s", "stage_hash_s"):
+        assert k in art["drain_stats_s"]
+        assert k in art["pipeline_stats_s"]
+    for k in ("stage_d2h", "stage_serialize", "stage_hash"):
+        assert k in art["intervals"]
+
+
+def test_stage_spans_emitted_under_session(tmp_path) -> None:
+    """With a telemetry session active, the sub-streams also land as
+    stage.d2h / stage.hash spans (serialize is ~instant for RAW but still
+    recorded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchsnapshot_tpu import telemetry
+
+    tm = telemetry.Telemetry()
+    arr = jax.random.normal(jax.random.PRNGKey(0), (256, 64), jnp.float32)
+    Snapshot.take(str(tmp_path / "ck"), {"m": StateDict(w=arr)}, _telemetry=tm)
+    assert tm.spans(name="stage.d2h")
+    assert tm.spans(name="stage.serialize")
+    assert tm.spans(name="stage.hash")
+
+
+def test_dedup_digests_off_skips_sha_and_shrinks_hash_stream(tmp_path) -> None:
+    """DEDUP_DIGESTS=0: the sidecar records no sha256 (crc only) — the
+    stage.hash stream measures the lighter fold."""
+    arr = np.arange(64 * 1024, dtype=np.float32)
+
+    def sidecar(dedup: bool):
+        storage = MemoryStoragePlugin()
+        _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+
+        async def go():
+            with knobs.override_dedup_digests(dedup):
+                pending = await execute_write_reqs(
+                    reqs, storage, memory_budget_bytes=10**9, rank=0
+                )
+                await pending.complete()
+                return pending
+
+        pending = _run(go())
+        return json.loads(storage.objects[".checksums.0"])["obj"], pending
+
+    (crc_on, _size_on, sha_on), p_on = sidecar(True)
+    (crc_off, _size_off, sha_off), p_off = sidecar(False)
+    assert crc_on == crc_off
+    assert sha_on is not None
+    assert sha_off is None
+    # Both pipelines measured a hash stream (crc still folds with sha off).
+    assert p_on.pipeline_stats["stage_hash_s"] >= 0
+    assert p_off.pipeline_stats["stage_hash_s"] >= 0
+
+
+def test_stager_outside_pipeline_still_works_without_context() -> None:
+    """Driven without an active StagingContext (no pipeline), the stager
+    falls back to the legacy hint chain — no lanes, no recording, same
+    bytes."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+    _entry, reqs = ArrayIOPreparer.prepare_write("obj", arr)
+    stager = reqs[0].buffer_stager
+
+    async def collect():
+        assert d2h.get_active() is None
+        chunks = []
+        with knobs.override_stream_chunk_bytes(2048):
+            async for c in stager.stage_chunks():
+                chunks.append(bytes(c))
+        return b"".join(chunks)
+
+    with knobs.override_stream_chunk_bytes(2048):
+        assert stager.can_stream()
+    data = _run(collect())
+    assert data == np.asarray(arr).tobytes()

@@ -96,9 +96,12 @@ def test_drain_stats_recorded(tmp_path) -> None:
     pending = Snapshot.async_take(str(tmp_path / "s"), app)
     snap = pending.wait()
     stats = pending.drain_stats
-    assert {"wall_s", "stage_busy_s", "io_busy_s", "overlap_s", "idle_s"} == set(
+    assert {"wall_s", "stage_busy_s", "io_busy_s", "overlap_s", "idle_s"} <= set(
         stats
     )
+    # stage_busy decomposes into the d2h/serialize/hash sub-streams.
+    assert {"stage_d2h_s", "stage_serialize_s", "stage_hash_s"} <= set(stats)
+    assert all(stats[k] >= 0 for k in ("stage_d2h_s", "stage_serialize_s"))
     assert stats["wall_s"] >= 0
     # Overlap can never exceed either stream's busy time, and the union of
     # busy + idle can never exceed wall (within float slop).
@@ -127,7 +130,7 @@ def test_sync_take_drain_stats_cover_staging(tmp_path) -> None:
     }
     Snapshot.take(str(tmp_path / "ckpt"), {"m": StateDict(**arrs)})
     stats = snapshot_mod.LAST_SYNC_DRAIN_STATS
-    assert {"wall_s", "stage_busy_s", "io_busy_s", "overlap_s", "idle_s"} == set(
+    assert {"wall_s", "stage_busy_s", "io_busy_s", "overlap_s", "idle_s"} <= set(
         stats
     )
     # The staging stream (device_get + serialize of 4 arrays) must be

@@ -261,13 +261,17 @@ def test_e2e_traced_take_and_restore(tmp_path) -> None:
         assert dur == pytest.approx(
             sum(s.dur for s in by_name[phase]), abs=1e-5
         )
-    # Drain stats: same keys as ever, now derived from the trace intervals.
+    # Drain stats: the classic keys plus the stage_busy decomposition
+    # (stage_d2h_s / stage_serialize_s / stage_hash_s sub-streams).
     assert {
         "wall_s",
         "stage_busy_s",
         "io_busy_s",
         "overlap_s",
         "idle_s",
+        "stage_d2h_s",
+        "stage_serialize_s",
+        "stage_hash_s",
     } == set(snapshot_mod.LAST_SYNC_DRAIN_STATS)
 
     # Scheduler stage/io spans.
@@ -328,7 +332,7 @@ def test_e2e_async_take_trace_written_on_commit(tmp_path) -> None:
         pending.wait()
     assert os.path.exists(trace_path)
     names = {s.name for s in spans_from_chrome_trace(json.load(open(trace_path)))}
-    assert {"capture", "scheduler.io", "storage.write", "d2h"} <= names
+    assert {"capture", "scheduler.io", "storage.write", "stage.d2h"} <= names
     # Session deactivated after commit: nothing global left behind.
     assert telemetry.get_active() is None
 

@@ -99,15 +99,25 @@ class CompressedSlabStager(BufferStager):
         self.frame_error: Optional[BaseException] = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        from . import d2h
         from .serialization import compress_member_framed
 
+        # Captured here, not inside work(): executor threads don't inherit
+        # the pipeline's StagingContext contextvar.
+        ctx = d2h.get_active()
+        times = ctx.times if ctx is not None else None
         try:
             raw = await self.inner.stage_buffer(executor)
 
             def work() -> bytes:
+                t0 = time.monotonic()
                 payload, sizes = compress_member_framed(
                     raw, self.member_sizes, self.serializer, self.level
                 )
+                if times is not None:
+                    times.record(
+                        "serialize", t0, time.monotonic(), nbytes=len(payload)
+                    )
                 self.frame_sizes = sizes
                 return payload
 

@@ -1,0 +1,281 @@
+"""Parallel device→host transfer lanes + stage-time attribution.
+
+The background drain used to resolve device→host transfers one ``np.asarray``
+at a time per request: the staging stream was a chain of
+hint → resolve → serialize → hash → append steps in which the link sat idle
+for every serialize/hash gap. BENCH rounds 2→5 measured the cost —
+``stage_busy`` at 95-99% of drain wall while ``io_busy`` stayed under 10%,
+and ``drain_vs_link`` stuck at ~0.66. This module closes the gap with two
+cooperating pieces:
+
+- :class:`TransferLanes` — N concurrent transfer lanes (a dedicated
+  ``ThreadPoolExecutor``, knob ``TORCHSNAPSHOT_TPU_D2H_LANES``) plus a
+  byte-bounded *hint window* (knob ``TORCHSNAPSHOT_TPU_D2H_WINDOW_BYTES``):
+  ``copy_to_host_async()`` is issued for a window of upcoming chunks/requests
+  the moment window space admits them, and the (already in-flight) transfers
+  resolve out of the lane executor concurrently — so the transfer engine
+  streams back-to-back while serialize/hash/append work on earlier chunks.
+  Window admissions are debited against the pipeline's existing memory
+  budget (the resolved host buffers are real RAM), and every admission is
+  released by the time a stream ends or aborts.
+- :class:`StageTimes` — a thread-safe sink for the staging stream's
+  sub-phase intervals (``d2h`` / ``serialize`` / ``hash``). The scheduler
+  derives ``stage_d2h_s``/``stage_serialize_s``/``stage_hash_s`` from these
+  by the same interval-union algebra as the stage/io streams, so the
+  monolithic ``stage_busy`` decomposes in drain stats, persisted telemetry
+  artifacts, and bench output — the next staging regression is attributable
+  instead of a single opaque number. With a telemetry session active the
+  same intervals are exported as ``stage.d2h``/``stage.serialize``/
+  ``stage.hash`` spans.
+
+The write pipeline activates a :class:`StagingContext` (lanes + times) via a
+``contextvars.ContextVar`` around staging-task creation — the same pattern
+telemetry uses — so stagers pick it up with one ``get_active()`` call and
+degrade gracefully (no lanes, no recording) when driven outside a pipeline.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .utils import knobs
+
+logger = logging.getLogger(__name__)
+
+
+# One warning per process when a platform lacks the async D2H hint — not one
+# per array per take. (Moved here from io_preparers/array.py, which
+# re-exports it: the lanes issue hints too, and the single owner of the
+# "hint unsupported" state must sit below both.)
+_hint_unsupported_warned = False
+
+
+def hint_copy_to_host(arr: Any) -> None:
+    """Best-effort ``copy_to_host_async`` D2H hint.
+
+    Only the narrow "this platform/array doesn't implement the hint" errors
+    are swallowed (logged once; ``np.asarray`` still works, just without the
+    overlap). A real XLA transfer failure propagates — silently retrying it
+    as a blocking ``np.asarray`` would hide the device-side error until it
+    resurfaces somewhere far less attributable."""
+    global _hint_unsupported_warned
+    try:
+        arr.copy_to_host_async()
+    except (NotImplementedError, AttributeError) as e:
+        if not _hint_unsupported_warned:
+            _hint_unsupported_warned = True
+            logger.info(
+                "copy_to_host_async unavailable on this platform (%s); "
+                "D2H transfers will not be hinted ahead of np.asarray", e
+            )
+
+
+class StageTimes:
+    """Thread-safe recorder of staging sub-phase intervals.
+
+    ``record`` is called from the event loop (await-measured blocks) and
+    from lane/staging/hash executor threads (thunk-measured blocks) alike;
+    appends take a lock, matching the trace buffer's own discipline. The
+    telemetry session is captured at construction because executor threads
+    don't inherit the activation contextvar."""
+
+    KINDS = ("d2h", "serialize", "hash")
+
+    def __init__(self, tm: Optional[Any] = None) -> None:
+        # ``tm``: the op's telemetry.Telemetry session (or None when off).
+        self._tm = tm
+        self._lock = threading.Lock()
+        self._intervals: Dict[str, List[Tuple[float, float]]] = {
+            k: [] for k in self.KINDS
+        }
+
+    def record(
+        self,
+        kind: str,
+        t0: float,
+        t1: float,
+        path: str = "",
+        nbytes: int = 0,
+    ) -> None:
+        with self._lock:
+            self._intervals[kind].append((t0, t1))
+        tm = self._tm
+        if tm is not None:
+            tm.add_span(
+                f"stage.{kind}",
+                "stage",
+                t0,
+                t1 - t0,
+                {"path": path, "nbytes": nbytes},
+            )
+            if kind == "d2h":
+                tm.metrics.counter("d2h.bytes").add(nbytes)
+                tm.metrics.histogram("d2h.seconds").observe(t1 - t0)
+
+    def intervals(self) -> Dict[str, List[Tuple[float, float]]]:
+        """A snapshot copy per kind (safe to merge/clip while staging runs)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._intervals.items()}
+
+
+class TransferLanes:
+    """N concurrent D2H resolution lanes + a byte-bounded hint window.
+
+    The window bounds how many bytes of *upcoming* (not-yet-consumed) chunks
+    may be hinted and resolving at once; admissions are optionally debited
+    against the pipeline's memory budget via :meth:`bind_budget` (the
+    resolved host buffers are real RAM the budget must see). ``try_admit``
+    never blocks — a full window simply means no further look-ahead this
+    round, and the caller re-pumps when it releases — so the lanes can
+    never deadlock a pipeline, only stop helping it.
+    """
+
+    def __init__(
+        self,
+        lanes: Optional[int] = None,
+        window_bytes: Optional[int] = None,
+    ) -> None:
+        self.lane_count = lanes if lanes is not None else knobs.get_d2h_lanes()
+        self.window_bytes = (
+            window_bytes
+            if window_bytes is not None
+            else knobs.get_d2h_window_bytes()
+        )
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        # Peak admitted bytes — test/telemetry surface for the window bound.
+        self.window_hwm = 0
+        self._on_admit: Optional[Callable[[int], None]] = None
+        self._on_release: Optional[Callable[[int], None]] = None
+        self._headroom: Optional[Callable[[], int]] = None
+
+    def bind_budget(
+        self,
+        on_admit: Callable[[int], None],
+        on_release: Callable[[int], None],
+        headroom: Optional[Callable[[], int]] = None,
+    ) -> None:
+        """Route window admissions through the owning pipeline's memory
+        budget (debit on admit, credit on release); ``headroom`` gates
+        non-forced admissions so look-ahead never starves request
+        admission of budget it needs more."""
+        self._on_admit = on_admit
+        self._on_release = on_release
+        self._headroom = headroom
+
+    def executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=self.lane_count,
+                thread_name_prefix="tss-d2h",
+            )
+        return self._executor
+
+    @property
+    def outstanding_bytes(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def try_admit(self, nbytes: int, force: bool = False) -> bool:
+        """Reserve window space for one upcoming transfer. ``force`` admits
+        regardless (each stream's FIRST look-ahead chunk, so a window
+        smaller than a chunk degrades to one-ahead instead of none)."""
+        with self._lock:
+            if not force:
+                if self._outstanding + nbytes > self.window_bytes:
+                    return False
+                if self._headroom is not None and self._headroom() < nbytes:
+                    return False
+            self._outstanding += nbytes
+            if self._outstanding > self.window_hwm:
+                self.window_hwm = self._outstanding
+        if self._on_admit is not None:
+            self._on_admit(nbytes)
+        return True
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._outstanding -= nbytes
+        if self._on_release is not None:
+            self._on_release(nbytes)
+
+    def release_all(self) -> int:
+        """Abort-path sweep: credit whatever is still admitted (normally 0 —
+        streams release their own admissions in their cleanup) so the
+        budget-balanced invariant holds on every failure path."""
+        with self._lock:
+            n = self._outstanding
+            self._outstanding = 0
+        if n and self._on_release is not None:
+            self._on_release(n)
+        return n
+
+    def start(
+        self,
+        arr: Any,
+        nbytes: int,
+        loop,
+        times: Optional[StageTimes] = None,
+        location: str = "",
+        skip_hint: bool = False,
+    ):
+        """Hint ``arr``'s transfer NOW and schedule its resolve on a lane.
+
+        Returns an awaitable future of the host ``np.ndarray``. The resolve
+        is timed inside the lane thread, so the recorded ``d2h`` interval is
+        transfer time only — not the time the future waited to be awaited
+        (that wait is exactly the overlap the lanes exist to create)."""
+        if not skip_hint:
+            hint_copy_to_host(arr)
+
+        def resolve() -> np.ndarray:
+            t0 = time.monotonic()
+            host = np.asarray(arr)
+            if times is not None:
+                times.record(
+                    "d2h", t0, time.monotonic(), path=location, nbytes=nbytes
+                )
+            return host
+
+        return loop.run_in_executor(self.executor(), resolve)
+
+    def shutdown(self, cancel_queued: bool = False) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=cancel_queued)
+            self._executor = None
+
+
+class StagingContext:
+    """What one write pipeline exposes to its stagers: the transfer lanes
+    and the sub-phase interval sink."""
+
+    __slots__ = ("lanes", "times")
+
+    def __init__(self, lanes: TransferLanes, times: StageTimes) -> None:
+        self.lanes = lanes
+        self.times = times
+
+
+_ACTIVE: contextvars.ContextVar[Optional[StagingContext]] = (
+    contextvars.ContextVar("torchsnapshot_tpu_staging_ctx", default=None)
+)
+
+
+def get_active() -> Optional[StagingContext]:
+    return _ACTIVE.get()
+
+
+def activate(ctx: Optional[StagingContext]) -> contextvars.Token:
+    return _ACTIVE.set(ctx)
+
+
+def deactivate(token: contextvars.Token) -> None:
+    _ACTIVE.reset(token)

@@ -23,13 +23,14 @@ import asyncio
 import logging
 import math
 import pickle
+import time
 from collections import deque
 from concurrent.futures import Executor
 from typing import Any, AsyncIterator, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import d2h, telemetry
 from ..io_types import BufferConsumer, BufferStager, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry
 from ..serialization import (
@@ -65,29 +66,9 @@ def _is_jax_array(obj: Any) -> bool:
     return isinstance(obj, jax.Array)
 
 
-# One warning per process when a platform lacks the async D2H hint — not one
-# per array per take.
-_hint_unsupported_warned = False
-
-
-def hint_copy_to_host(arr: Any) -> None:
-    """Best-effort ``copy_to_host_async`` D2H hint.
-
-    Only the narrow "this platform/array doesn't implement the hint" errors
-    are swallowed (logged once; ``np.asarray`` still works, just without the
-    overlap). A real XLA transfer failure propagates — silently retrying it
-    as a blocking ``np.asarray`` would hide the device-side error until it
-    resurfaces somewhere far less attributable."""
-    global _hint_unsupported_warned
-    try:
-        arr.copy_to_host_async()
-    except (NotImplementedError, AttributeError) as e:
-        if not _hint_unsupported_warned:
-            _hint_unsupported_warned = True
-            logger.info(
-                "copy_to_host_async unavailable on this platform (%s); "
-                "D2H transfers will not be hinted ahead of np.asarray", e
-            )
+# The hint's single owner moved to ``d2h`` (the transfer lanes issue hints
+# too); re-exported here for the existing importers (io_preparer, tests).
+hint_copy_to_host = d2h.hint_copy_to_host
 
 
 def chunk_row_ranges(
@@ -113,6 +94,13 @@ def chunk_row_ranges(
     return ranges
 
 
+def _silence_future(fut) -> None:
+    """Retrieve (and drop) an abandoned lane resolve's outcome so asyncio
+    never logs "exception was never retrieved" for work we cancelled."""
+    if not fut.cancelled():
+        fut.exception()
+
+
 def to_host(arr: Any, executor: Optional[Executor] = None):
     """Kick off an async D2H transfer; return an awaitable resolver."""
     if _is_jax_array(arr):
@@ -130,13 +118,25 @@ def to_host(arr: Any, executor: Optional[Executor] = None):
 async def _traced_to_host(
     arr: Any, executor: Optional[Executor], location: str, nbytes: int
 ) -> np.ndarray:
-    """:func:`to_host` under a ``d2h`` telemetry span (+ bytes/seconds
-    metrics). Free None-check when no session is active — the span branch
-    never runs on untraced takes."""
+    """Resolve one device→host transfer, attributed as ``stage.d2h``.
+
+    Inside a write pipeline (an active :class:`~..d2h.StagingContext`) the
+    resolve runs on the DEDICATED transfer-lane executor — never queued
+    behind serialize/compress jobs on the staging pool — and the lane
+    records the transfer interval for the stage-time decomposition. Outside
+    a pipeline it falls back to :func:`to_host` on the given executor, with
+    a ``stage.d2h`` span when a telemetry session is active (free
+    None-checks otherwise)."""
+    ctx = d2h.get_active()
+    if ctx is not None:
+        loop = asyncio.get_running_loop()
+        return await ctx.lanes.start(
+            arr, nbytes, loop, times=ctx.times, location=location
+        )
     tm = telemetry.get_active()
     if tm is None:
         return await to_host(arr, executor)()
-    with tm.span("d2h", "d2h", path=location, nbytes=nbytes) as sp:
+    with tm.span("stage.d2h", "stage", path=location, nbytes=nbytes) as sp:
         host = await to_host(arr, executor)()
     tm.metrics.counter("d2h.bytes").add(nbytes)
     tm.metrics.histogram("d2h.seconds").observe(sp.span.dur or 0.0)
@@ -217,8 +217,24 @@ class ArrayBufferStager(BufferStager):
                 host = host.copy()
             elif not host.flags["C_CONTIGUOUS"]:
                 host = np.ascontiguousarray(host)
+        ctx = d2h.get_active()
+        times = ctx.times if ctx is not None else None
+        location = self.entry.location
         if serializer == Serializer.RAW:
-            return array_as_bytes_view(host)
+            # Zero-copy fast path: the staged buffer IS a memoryview of the
+            # resolved host buffer — no serialization pass, no intermediate
+            # bytes(). Downstream (write_stream appends, plugin writes, the
+            # digest fold, slab packing) all consume the buffer protocol
+            # directly, so the only full sweeps over a RAW payload are the
+            # transfer itself, the (optional) hash, and the storage write.
+            t0 = time.monotonic()
+            view = array_as_bytes_view(host)
+            if times is not None:
+                times.record(
+                    "serialize", t0, time.monotonic(),
+                    path=location, nbytes=view.nbytes,
+                )
+            return view
         if is_raw_family(self.entry.serializer):
             # Compress on the executor: seconds of zstd on a large shard
             # must not block the event loop that dispatches every other
@@ -228,12 +244,18 @@ class ArrayBufferStager(BufferStager):
             loop = asyncio.get_running_loop()
             if self.entry.frame_bytes:
                 def framed():
+                    t0 = time.monotonic()
                     payload, sizes = compress_framed(
                         view,
                         self.entry.serializer,
                         level,
                         self.entry.frame_bytes,
                     )
+                    if times is not None:
+                        times.record(
+                            "serialize", t0, time.monotonic(),
+                            path=location, nbytes=len(payload),
+                        )
                     # Publish for the companion FrameTableStager (same
                     # pipeline, polls until this lands).
                     self.frame_sizes = sizes
@@ -242,12 +264,28 @@ class ArrayBufferStager(BufferStager):
                 if executor is not None:
                     return await loop.run_in_executor(executor, framed)
                 return framed()
+
+            def compress():
+                t0 = time.monotonic()
+                payload = compress_payload(view, self.entry.serializer, level)
+                if times is not None:
+                    times.record(
+                        "serialize", t0, time.monotonic(),
+                        path=location, nbytes=len(payload),
+                    )
+                return payload
+
             if executor is not None:
-                return await loop.run_in_executor(
-                    executor, compress_payload, view, self.entry.serializer, level
-                )
-            return compress_payload(view, self.entry.serializer, level)
-        return pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
+                return await loop.run_in_executor(executor, compress)
+            return compress()
+        t0 = time.monotonic()
+        payload = pickle.dumps(host, protocol=pickle.HIGHEST_PROTOCOL)
+        if times is not None:
+            times.record(
+                "serialize", t0, time.monotonic(),
+                path=location, nbytes=len(payload),
+            )
+        return payload
 
     def get_staging_cost_bytes(self) -> int:
         if not is_raw_family(self.entry.serializer):
@@ -296,14 +334,28 @@ class ArrayBufferStager(BufferStager):
         self, executor: Optional[Executor] = None
     ) -> AsyncIterator[BufferType]:
         """Dim-0 chunk stream whose concatenation is byte-identical to
-        :meth:`stage_buffer`'s output. Per chunk: slice on device, hint the
-        NEXT chunk's D2H before resolving this one (so the transfer engine
-        streams back-to-back), then serialize. Framed compression emits
-        whole ``frame_bytes`` frames and carries the inter-chunk remainder,
-        so the frame layout (and the published ``frame_sizes``) matches the
-        non-streamed path exactly."""
+        :meth:`stage_buffer`'s output.
+
+        Inside a write pipeline the upcoming chunks' transfers run on the
+        PARALLEL D2H LANES: each chunk the lane window admits is hinted
+        (``copy_to_host_async``) and starts resolving on the transfer
+        executor immediately, so several transfers stream back-to-back
+        while this coroutine serializes/yields earlier chunks — look-ahead
+        depth is bounded by ``TORCHSNAPSHOT_TPU_D2H_WINDOW_BYTES`` (debited
+        against the pipeline's memory budget), not a fixed chunk count.
+        Outside a pipeline, the round-3 two-ahead hint chain is kept.
+        RAW chunks are yielded as zero-copy memoryviews of the resolved
+        host buffers. Framed compression emits whole ``frame_bytes`` frames
+        and carries the inter-chunk remainder, so the frame layout (and the
+        published ``frame_sizes``) matches the non-streamed path exactly."""
         serializer = self.entry.serializer
         framed = serializer != Serializer.RAW
+        ctx = d2h.get_active()
+        times = ctx.times if ctx is not None else None
+        lanes = ctx.lanes if ctx is not None else None
+        location = self.entry.location
+        # Lane-resolving look-ahead: (host-array future, admitted bytes).
+        pending: deque = deque()
         try:
             ranges = self._stream_row_ranges()
             arr = self.arr
@@ -318,42 +370,102 @@ class ArrayBufferStager(BufferStager):
             frame_bytes = self.entry.frame_bytes
             carry = bytearray()  # raw tail short of a full compression frame
             sizes: List[int] = []
-            # Pre-hinted device slices, two chunks ahead of the resolve so
-            # transfers pipeline on high-latency links (one-ahead leaves the
-            # link idle for a round-trip between chunks). Bounded depth: each
-            # hinted slice caches its host bytes, so the look-ahead is part
-            # of the stream's RAM footprint.
-            hinted: deque = deque()
-            if self._first_slice is not None and (
+            first_slice = self._first_slice
+            self._first_slice = None
+            if first_slice is not None and (
                 not ranges
-                or int(self._first_slice.shape[0]) != ranges[0][1] - ranges[0][0]
+                or int(first_slice.shape[0]) != ranges[0][1] - ranges[0][0]
             ):
                 # Chunk knob changed between capture and drain: the
                 # pre-hinted slice no longer matches the first range.
-                self._first_slice = None
-            if self._first_slice is not None:
-                hinted.append(self._first_slice)
-                self._first_slice = None
+                first_slice = None
+            itemsize = entry_np_dtype(self.entry.dtype, serializer).itemsize
+            row_bytes = (
+                itemsize * int(np.prod(self.entry.shape[1:]))
+                if len(self.entry.shape) > 1
+                else itemsize
+            )
+            next_i = 0  # next range index to enter the look-ahead
+
+            def pump() -> None:
+                # Fill the lane window with upcoming chunks: hint + start
+                # resolving each one the window (and budget headroom)
+                # admits. The first look-ahead chunk of an empty stream is
+                # force-admitted so a window smaller than one chunk
+                # degrades to one-ahead, never to a stall.
+                nonlocal next_i, first_slice
+                while next_i < len(ranges):
+                    nr0, nr1 = ranges[next_i]
+                    est = (nr1 - nr0) * row_bytes
+                    if not lanes.try_admit(est, force=not pending):
+                        break
+                    if first_slice is not None:
+                        s, skip_hint = first_slice, True
+                        first_slice = None
+                    else:
+                        s, skip_hint = arr[nr0:nr1], False
+                    pending.append(
+                        (
+                            lanes.start(
+                                s,
+                                est,
+                                loop,
+                                times=times,
+                                location=location,
+                                skip_hint=skip_hint,
+                            ),
+                            est,
+                        )
+                    )
+                    next_i += 1
+
+            # Legacy (no active pipeline) look-ahead: pre-hinted device
+            # slices, two chunks ahead of the resolve so transfers pipeline
+            # on high-latency links. Each hinted slice caches its host
+            # bytes, so the look-ahead is part of the stream's footprint.
+            hinted: deque = deque()
+            if lanes is None and first_slice is not None:
+                hinted.append(first_slice)
+                first_slice = None
             _HINT_AHEAD = 2
             for i, (r0, r1) in enumerate(ranges):
                 if is_jax:
-                    while len(hinted) < _HINT_AHEAD + 1 and i + len(
-                        hinted
-                    ) < len(ranges):
-                        nr0, nr1 = ranges[i + len(hinted)]
-                        s = arr[nr0:nr1]
-                        hint_copy_to_host(s)
-                        hinted.append(s)
-                    cur = hinted.popleft()
-                    host = await _traced_to_host(
-                        cur, executor, self.entry.location, _nbytes_of(cur)
-                    )
-                    if not host.flags["C_CONTIGUOUS"]:
-                        host = np.ascontiguousarray(host)
+                    if lanes is not None:
+                        pump()
+                        fut, est = pending.popleft()
+                        # Release the window reservation before resolving:
+                        # from here the chunk's bytes are covered by the
+                        # stream's own per-chunk budget debit
+                        # (scheduler._stream_one), and the freed window
+                        # immediately admits the next look-ahead transfer.
+                        lanes.release(est)
+                        host = await fut
+                        pump()
+                    else:
+                        while len(hinted) < _HINT_AHEAD + 1 and i + len(
+                            hinted
+                        ) < len(ranges):
+                            nr0, nr1 = ranges[i + len(hinted)]
+                            s = arr[nr0:nr1]
+                            hint_copy_to_host(s)
+                            hinted.append(s)
+                        cur = hinted.popleft()
+                        host = await _traced_to_host(
+                            cur, executor, location, _nbytes_of(cur)
+                        )
                 else:
                     host = host_full[r0:r1]
+                # Contiguity (the only copy a RAW chunk can ever pay) is
+                # owned by array_as_bytes_view — one pass, zero when the
+                # device layout is already C-order.
+                t0 = time.monotonic()
                 view = array_as_bytes_view(host)
                 if not framed:
+                    if times is not None:
+                        times.record(
+                            "serialize", t0, time.monotonic(),
+                            path=location, nbytes=view.nbytes,
+                        )
                     yield view
                     continue
                 carry.extend(view)
@@ -369,7 +481,14 @@ class ArrayBufferStager(BufferStager):
                     del carry[: nframes * frame_bytes]
 
                 def compress_block(block=block):
-                    return compress_framed(block, serializer, level, frame_bytes)
+                    t0 = time.monotonic()
+                    out = compress_framed(block, serializer, level, frame_bytes)
+                    if times is not None:
+                        times.record(
+                            "serialize", t0, time.monotonic(),
+                            path=location, nbytes=len(out[0]),
+                        )
+                    return out
 
                 if executor is not None:
                     payload, fsizes = await loop.run_in_executor(
@@ -387,6 +506,15 @@ class ArrayBufferStager(BufferStager):
             if self.entry.frame_bytes:
                 self.frame_error = e
             raise
+        finally:
+            # Abandoned look-ahead (mid-stream failure, aclose from an
+            # aborting pipeline): release every window admission so the
+            # budget balances, and silence the orphaned resolves.
+            while pending:
+                fut, est = pending.popleft()
+                fut.cancel()
+                fut.add_done_callback(_silence_future)
+                lanes.release(est)
 
     def start_d2h_hint(self) -> None:
         if not _is_jax_array(self.arr):

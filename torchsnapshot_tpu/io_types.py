@@ -165,7 +165,12 @@ class BufferedWriteStream(StorageWriteStream):
     visibility rides on ``write``'s own guarantees) but holds the whole
     object in memory — plugins advertise true incremental appends by
     setting ``supports_streaming = True`` and overriding ``write_stream``;
-    the scheduler only routes requests through streams on those."""
+    the scheduler only routes requests through streams on those.
+
+    Appended buffers are retained AS-IS (zero-copy: a memoryview keeps its
+    backing host buffer alive until commit/abort, matching the stream
+    contract that appended bytes are immutable until the stream ends) and
+    joined once at commit."""
 
     def __init__(self, storage: "StoragePlugin", path: str) -> None:
         self._storage = storage
@@ -173,7 +178,7 @@ class BufferedWriteStream(StorageWriteStream):
         self._chunks: list = []
 
     async def append(self, buf: BufferType) -> None:
-        self._chunks.append(bytes(buf))
+        self._chunks.append(buf)
 
     async def commit(self) -> None:
         await self._storage.write(

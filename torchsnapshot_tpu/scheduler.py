@@ -49,7 +49,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import telemetry
+from . import d2h, telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .utils import knobs
 
@@ -210,6 +210,7 @@ class PipelinePools:
         self._staging: Optional[ThreadPoolExecutor] = None
         self._hash: Optional[ThreadPoolExecutor] = None
         self._consuming: Optional[ThreadPoolExecutor] = None
+        self._lanes: Optional[d2h.TransferLanes] = None
 
     def staging_executor(self) -> ThreadPoolExecutor:
         if self._staging is None:
@@ -238,11 +239,21 @@ class PipelinePools:
             )
         return self._consuming
 
+    def transfer_lanes(self) -> d2h.TransferLanes:
+        """The operation's parallel D2H lanes (dedicated transfer executor +
+        hint window; see ``d2h.TransferLanes``). Sized by the D2H_LANES /
+        D2H_WINDOW_BYTES knobs at first use."""
+        if self._lanes is None:
+            self._lanes = d2h.TransferLanes()
+        return self._lanes
+
     def shutdown(self, cancel_queued: bool = False) -> None:
         for ex in (self._staging, self._hash, self._consuming):
             if ex is not None:
                 ex.shutdown(wait=False, cancel_futures=cancel_queued)
-        self._staging = self._hash = self._consuming = None
+        if self._lanes is not None:
+            self._lanes.shutdown(cancel_queued=cancel_queued)
+        self._staging = self._hash = self._consuming = self._lanes = None
 
 
 class _Budget:
@@ -389,6 +400,20 @@ class _WritePipeline:
         self._tm = telemetry.get_active()
         self._stage_intervals: List[Tuple[float, float]] = []
         self._io_intervals: List[Tuple[float, float]] = []
+        # Parallel D2H lanes + stage-time attribution, exposed to stagers
+        # via the d2h contextvar around staging-task creation. Lane-window
+        # admissions (look-ahead host buffers) debit THIS pipeline's budget
+        # and are fully released by stream cleanup / _abort_inflight, so
+        # budget_balanced still holds on every path.
+        self._staging_ctx = d2h.StagingContext(
+            lanes=self.pools.transfer_lanes(),
+            times=d2h.StageTimes(tm=self._tm),
+        )
+        self._staging_ctx.lanes.bind_budget(
+            self.budget.debit,
+            self.budget.credit,
+            headroom=lambda: self.budget.available,
+        )
         # Accounting windows: the wait loops' [start, end] spans. Stats
         # attribute only in-window activity (the async gap between capture
         # point and background drain is nobody's time).
@@ -468,6 +493,17 @@ class _WritePipeline:
         return stager.can_stream()
 
     def _dispatch_staging(self) -> None:
+        # Staging tasks are created under the pipeline's StagingContext:
+        # ensure_future snapshots the contextvar, so every stager (and the
+        # sub-tasks it spawns) sees the transfer lanes + interval sink via
+        # d2h.get_active() — no signature change to the stager protocol.
+        token = d2h.activate(self._staging_ctx)
+        try:
+            self._dispatch_staging_inner()
+        finally:
+            d2h.deactivate(token)
+
+    def _dispatch_staging_inner(self) -> None:
         if self.executor is None:
             self.executor = self.pools.staging_executor()
         max_io = knobs.get_max_concurrent_io_for(self.storage)
@@ -602,6 +638,8 @@ class _WritePipeline:
             # so the sentinel is only needed on normal completion).
             await queue.put((_END, 0))
 
+        times = self._staging_ctx.times
+
         async def consume() -> None:
             nonlocal crc, total, outstanding
             while True:
@@ -610,15 +648,26 @@ class _WritePipeline:
                     return
                 if want_digest:
                     # Fold this chunk into the object's running digest on
-                    # the hash pool (GIL released); sequential per stream,
-                    # so chunk order — and thus the digest — is exact.
+                    # the hash pool (GIL released, never the staging
+                    # thread); sequential per stream, so chunk order — and
+                    # thus the digest — is exact. Folds directly over the
+                    # staged view (no copy); sha256 is skipped entirely
+                    # when dedup digests are off. Timed inside the thunk:
+                    # the ``hash`` sub-stream measures hashing, not queue
+                    # wait.
                     if self._crc_executor is None:
                         self._crc_executor = self.pools.hash_executor()
 
                     def fold(mv=memoryview(buf), c=crc):
+                        t0 = time.monotonic()
                         if sha is not None:
                             sha.update(mv)
-                        return zlib.crc32(mv, c)
+                        out = zlib.crc32(mv, c)
+                        times.record(
+                            "hash", t0, time.monotonic(),
+                            path=req.path, nbytes=mv.nbytes,
+                        )
+                        return out
 
                     crc = await loop.run_in_executor(self._crc_executor, fold)
                 t0 = time.monotonic()
@@ -669,6 +718,19 @@ class _WritePipeline:
                 crc, total, sha.hexdigest() if sha is not None else None
             ]
 
+    def _timed_hash(self, path: str, nbytes: int, fn):
+        """Run one hashing thunk with its interval recorded in the ``hash``
+        sub-stream (the thunk itself executes on the hash pool)."""
+        times = self._staging_ctx.times
+
+        def work():
+            t0 = time.monotonic()
+            out = fn()
+            times.record("hash", t0, time.monotonic(), path=path, nbytes=nbytes)
+            return out
+
+        return work
+
     async def _write_one(self, path: str, buf) -> None:
         if knobs.is_checksums_enabled():
             # Hashing releases the GIL; it runs on its own pool (width =
@@ -717,16 +779,19 @@ class _WritePipeline:
                 write_io = WriteIO(path=path, buf=buf, want_digest=True)
                 await self.storage.write(write_io)
                 digest = write_io.digest_out
+                mv = memoryview(buf)
                 if digest is None:
                     digest = await loop.run_in_executor(
                         self._crc_executor,
-                        _digest_buffer,
-                        memoryview(buf),
-                        self._want_sha,
+                        self._timed_hash(
+                            path,
+                            mv.nbytes,
+                            lambda: _digest_buffer(mv, self._want_sha),
+                        ),
                     )
                 elif digest[2] is None and self._want_sha:
 
-                    def sha_only(mv=memoryview(buf)):
+                    def sha_only(mv=mv):
                         h = hashlib.sha256()
                         h.update(mv)
                         return h.hexdigest()
@@ -734,12 +799,19 @@ class _WritePipeline:
                     digest = [
                         digest[0],
                         digest[1],
-                        await loop.run_in_executor(self._crc_executor, sha_only),
+                        await loop.run_in_executor(
+                            self._crc_executor,
+                            self._timed_hash(path, mv.nbytes, sha_only),
+                        ),
                     ]
                 self.checksums[path] = digest
                 return
+            mv = memoryview(buf)
             digest = await loop.run_in_executor(
-                self._crc_executor, _digest_buffer, memoryview(buf), self._want_sha
+                self._crc_executor,
+                self._timed_hash(
+                    path, mv.nbytes, lambda: _digest_buffer(mv, self._want_sha)
+                ),
             )
             self.checksums[path] = digest
             if digest[2] is not None:
@@ -801,6 +873,10 @@ class _WritePipeline:
         while self.ready_for_io:
             _path, buf = self.ready_for_io.popleft()
             self.budget.credit(memoryview(buf).nbytes)
+        # Look-ahead transfers the cancelled streams didn't get to release
+        # themselves (their cleanup normally does) — sweep the remainder so
+        # the budget balances on every failure path.
+        self._staging_ctx.lanes.release_all()
 
     def _reap(self, done) -> None:
         for task in done:
@@ -984,6 +1060,22 @@ class _WritePipeline:
         self.pipeline_stats = _stream_stats(
             self._windows, self._stage_intervals, self._io_intervals
         )
+        # Decompose stage_busy into its sub-streams (D2H resolve, serialize/
+        # compress, hash fold) from the StageTimes intervals — same union/
+        # clip algebra, so the stats and the stage.* trace spans can never
+        # disagree. With parallel lanes the sub-streams overlap each other,
+        # so their sum may legitimately EXCEED stage_busy_s (that overlap is
+        # the speedup); each value reads "seconds this sub-stream was busy".
+        sub = self._staging_ctx.times.intervals()
+        for kind, ivs in sub.items():
+            merged = _merge_intervals(ivs)
+            self.drain_stats[f"stage_{kind}_s"] = _measure(
+                _clip_merged(merged, *drain_window)
+            )
+            self.pipeline_stats[f"stage_{kind}_s"] = sum(
+                _measure(_clip_merged(merged, w0, w1))
+                for w0, w1 in self._windows
+            )
         # Pipeline-level metrics (no-ops unless a telemetry session is on).
         telemetry.gauge_max(
             "scheduler.budget_hwm_bytes", self.budget.high_water_bytes
@@ -1145,6 +1237,12 @@ class PendingIOWork:
             "windows": list(p._windows),
             "stage_intervals": _merge_intervals(p._stage_intervals),
             "io_intervals": _merge_intervals(p._io_intervals),
+            # stage_busy decomposed: merged d2h/serialize/hash sub-stream
+            # intervals (the artifact persists them beside stage/io).
+            "stage_substreams": {
+                kind: _merge_intervals(ivs)
+                for kind, ivs in p._staging_ctx.times.intervals().items()
+            },
         }
 
 

@@ -275,16 +275,21 @@ def array_nbytes(shape, dtype_str: str) -> int:
 def array_as_bytes_view(arr: np.ndarray) -> memoryview:
     """Zero-copy little-endian raw-byte view of ``arr``.
 
-    Copies only when the array is non-contiguous or big-endian. Device
-    fetches CAN be non-C-contiguous: ``np.asarray(jax.Array)`` reflects the
-    device layout, which for e.g. bf16 matrices on TPU may be F-order.
+    Copies only when the array is non-contiguous or big-endian (single
+    owner of the contiguity fix — callers hand the host array straight in).
+    Device fetches CAN be non-C-contiguous: ``np.asarray(jax.Array)``
+    reflects the device layout, which for e.g. bf16 matrices on TPU may be
+    F-order. The view is the RAW staging fast path's terminal product: it
+    flows into ``write_stream`` appends / plugin writes / the digest fold
+    with no intermediate ``bytes()`` materialization, and it keeps the host
+    buffer alive for as long as any consumer holds it.
     """
     arr = np.ascontiguousarray(arr)
     if arr.dtype.byteorder == ">":
         arr = arr.astype(arr.dtype.newbyteorder("<"))
-    # ml_dtypes custom dtypes reject PEP-3118 export; a uint8 view never does.
-    flat = arr.view(np.uint8).reshape(-1)
-    return memoryview(flat.data)
+    # ml_dtypes custom dtypes reject PEP-3118 export; a uint8 view never
+    # does — and ``.data`` already IS the memoryview (no re-wrap copy).
+    return arr.view(np.uint8).reshape(-1).data
 
 
 def array_from_bytes(buf, dtype_str: str, shape) -> np.ndarray:

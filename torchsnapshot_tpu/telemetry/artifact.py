@@ -117,6 +117,14 @@ def build_artifact(
             "stage": _round_intervals(io_summary.get("stage_intervals") or (), offset),
             "io": _round_intervals(io_summary.get("io_intervals") or (), offset),
         }
+        # stage_busy decomposition: merged d2h/serialize/hash sub-stream
+        # intervals (additive, schema v1-compatible — readers that don't
+        # know them ignore extra keys). The scalar views live in
+        # pipeline_stats_s/drain_stats_s as stage_<kind>_s.
+        for kind, ivs in (io_summary.get("stage_substreams") or {}).items():
+            artifact["intervals"][f"stage_{kind}"] = _round_intervals(
+                ivs, offset
+            )
     if tm is not None:
         artifact["metrics"] = tm.metrics.as_dict()
         artifact["spans_dropped"] = tm.buffer.dropped

@@ -572,6 +572,44 @@ def override_stream_inflight(value: int):
     return _override_env(_ENV_STREAM_INFLIGHT, str(value))
 
 
+_ENV_D2H_LANES = "TORCHSNAPSHOT_TPU_D2H_LANES"
+_ENV_D2H_WINDOW = "TORCHSNAPSHOT_TPU_D2H_WINDOW_BYTES"
+
+_DEFAULT_D2H_WINDOW_BYTES = 128 * 1024 * 1024
+
+
+def get_d2h_lanes() -> int:
+    """Concurrent device→host transfer lanes per write pipeline (default 4).
+
+    Each lane is one thread on a dedicated transfer executor that resolves
+    an already-hinted (``copy_to_host_async``) transfer via ``np.asarray``,
+    so several chunks' transfers stream back-to-back while earlier chunks
+    serialize/hash/append. Distinct from ``TORCHSNAPSHOT_TPU_STAGING_THREADS``
+    (the serialize/compress pool): a multi-second compression job on the
+    staging pool can no longer head-of-line block the transfer engine.
+    """
+    return max(1, _get_int(_ENV_D2H_LANES, 4))
+
+
+def get_d2h_window_bytes() -> int:
+    """Bytes of UPCOMING chunks/requests that may be hinted ahead and
+    resolving on the transfer lanes at once (default 128 MB). The window is
+    debited against the pipeline's memory budget as it fills — look-ahead
+    host buffers are real RAM — and each stream force-admits its first
+    look-ahead chunk, so a window smaller than one chunk (including 0)
+    degrades to one-chunk-ahead rather than stalling the transfer
+    engine."""
+    return max(0, _get_int(_ENV_D2H_WINDOW, _DEFAULT_D2H_WINDOW_BYTES))
+
+
+def override_d2h_lanes(value: int):
+    return _override_env(_ENV_D2H_LANES, str(value))
+
+
+def override_d2h_window_bytes(value: int):
+    return _override_env(_ENV_D2H_WINDOW, str(value))
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
