@@ -1,13 +1,20 @@
 """Checkpoint-invariant static analyzer (the ``dev/lint.py`` analysis gate).
 
-Five AST passes over the library, zero third-party dependencies:
+Eight AST passes over the library, zero third-party dependencies:
 
 1. async-safety (TSA1xx) — no blocking calls on the event loop;
-2. task-leak (TSA2xx) — every spawned task retained and reaped;
+2. task-leak (TSA2xx) — every spawned task AND executor future retained
+   and reaped;
 3. knob-drift (TSA3xx) — env knobs live in ``utils/knobs.py`` and the docs
    catalog, bidirectionally;
 4. telemetry-discipline (TSA4xx) — spans context-managed, names cataloged;
-5. manifest-schema (TSA5xx) — Entry fields stay JSON-serializable.
+5. manifest-schema (TSA5xx) — Entry fields stay JSON-serializable;
+6. resource-balance (TSA6xx) — flow-sensitive: every budget debit / lane
+   admission credited, handed off, or try/finally-protected on every path;
+7. thread-safety (TSA7xx) — no unguarded attribute mutation shared between
+   executor threads and the event loop;
+8. fault-coverage (TSA8xx) — every StoragePlugin/StorageWriteStream op
+   wrapped by FaultyStoragePlugin's injection map.
 
 Run: ``python -m dev.analyze`` (or via ``python dev/lint.py``).
 See ``docs/static-analysis.md`` for codes, suppression, and the baseline

@@ -56,6 +56,8 @@ def main(argv=None) -> int:
 
     ctx = default_context(args.root)
     if args.files:
+        # Missing/unreadable files surface as one-line TSA000 findings from
+        # the context (never a traceback) — same contract as syntax errors.
         ctx.lib_files = sorted(
             os.path.relpath(os.path.abspath(f), args.root) for f in args.files
         )
@@ -82,7 +84,10 @@ def main(argv=None) -> int:
         return 1
     n_base = len(load_baseline(args.baseline))
     suffix = f" ({n_base} grandfathered)" if n_base else ""
-    print(f"analyzer clean: {len(ctx.lib_files)} files, 5 passes{suffix}")
+    print(
+        f"analyzer clean: {len(ctx.lib_files)} files, "
+        f"{len(get_passes())} passes{suffix}"
+    )
     return 0
 
 

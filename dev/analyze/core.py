@@ -83,6 +83,8 @@ class AnalysisContext:
         telemetry_catalog_path: Optional[str] = None,
         telemetry_exempt_prefixes: Tuple[str, ...] = (),
         manifest_path: Optional[str] = None,
+        io_types_path: Optional[str] = None,
+        faults_path: Optional[str] = None,
     ) -> None:
         self.root = root
         self.lib_files = lib_files
@@ -92,25 +94,55 @@ class AnalysisContext:
         self.telemetry_catalog_path = telemetry_catalog_path
         self.telemetry_exempt_prefixes = telemetry_exempt_prefixes
         self.manifest_path = manifest_path
+        self.io_types_path = io_types_path
+        self.faults_path = faults_path
         self._sources: Dict[str, str] = {}
         self._trees: Dict[str, Optional[ast.AST]] = {}
+        self._parents: Dict[str, Dict[ast.AST, ast.AST]] = {}
         self.parse_failures: List[Finding] = []
 
     def source(self, relpath: str) -> str:
         if relpath not in self._sources:
-            with open(os.path.join(self.root, relpath), encoding="utf-8") as f:
-                self._sources[relpath] = f.read()
+            try:
+                with open(
+                    os.path.join(self.root, relpath), encoding="utf-8"
+                ) as f:
+                    self._sources[relpath] = f.read()
+            except OSError as e:
+                # Unreadable/missing file: ONE file:line finding (like a
+                # syntax error) instead of a traceback out of every pass
+                # that touches it.
+                self._sources[relpath] = ""
+                self._trees[relpath] = None
+                self.parse_failures.append(
+                    Finding(
+                        path=relpath,
+                        line=0,
+                        code="TSA000",
+                        message=f"file is not readable: {e.strerror or e}",
+                        key="unreadable",
+                    )
+                )
         return self._sources[relpath]
 
     def lines(self, relpath: str) -> List[str]:
         return self.source(relpath).split("\n")
 
+    def parents(self, relpath: str) -> Dict[ast.AST, ast.AST]:
+        """The file's child->parent map, computed once and shared by every
+        pass (task-leak, telemetry-discipline, thread-safety all need it)."""
+        if relpath not in self._parents:
+            tree = self.tree(relpath)
+            self._parents[relpath] = {} if tree is None else parent_map(tree)
+        return self._parents[relpath]
+
     def tree(self, relpath: str) -> Optional[ast.AST]:
         if relpath not in self._trees:
+            source = self.source(relpath)  # may record an unreadable-file
+            if relpath in self._trees:  # finding and pin the tree to None
+                return self._trees[relpath]
             try:
-                self._trees[relpath] = ast.parse(
-                    self.source(relpath), filename=relpath
-                )
+                self._trees[relpath] = ast.parse(source, filename=relpath)
             except SyntaxError as e:
                 self._trees[relpath] = None
                 self.parse_failures.append(
@@ -151,6 +183,225 @@ def dotted_name(func: ast.AST) -> Optional[str]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# Flow-sensitive statement walking (the TSA6xx resource-balance machinery).
+#
+# A full CFG for Python is overkill for the invariants this analyzer gates;
+# what the balance pass needs is *path sensitivity over statements*: which
+# abstract tokens (open budget debits) can be live when control reaches a
+# statement, an `await`, an early return, or the function's end — including
+# through if/else splits, loop back-edges, and try/except/finally. The
+# engine below walks one function body with a set of abstract states (each
+# state a frozenset of tokens), merging at joins and iterating loop bodies
+# to a fixpoint. Exceptional control flow is approximated structurally: a
+# statement that can raise either escapes the function (reported via
+# ``on_unprotected_raise``) or is covered by an enclosing try whose
+# handler/finally the subclass recognizes as *protecting* (releasing every
+# token). Nested function definitions are opaque — each is walked as its
+# own function by the pass driver.
+# ---------------------------------------------------------------------------
+
+
+class _LoopCtx:
+    __slots__ = ("breaks", "continues")
+
+    def __init__(self) -> None:
+        self.breaks: set = set()
+        self.continues: set = set()
+
+
+class FlowWalker:
+    """Abstract-state walker over ONE function body (see block comment).
+
+    Subclass hooks — ``state`` is a frozenset of pass-defined tokens:
+
+    - ``transfer(stmt, state) -> state``: effect of one simple statement;
+    - ``branch(test, state) -> (true_states, false_states)``: effect of a
+      branch condition (default: no effect on either side);
+    - ``try_protects(trystmt) -> bool``: whether this try's handlers or
+      finally release every live token on the exceptional path;
+    - ``may_raise(stmt) -> bool``: whether the statement can raise;
+    - ``on_await(stmt, state)``: a state observed at an ``await`` point
+      with no protecting try enclosing it;
+    - ``on_unprotected_raise(stmt, state)``: a state at a may-raise
+      statement with no protecting try enclosing it;
+    - ``on_exit(node, state, how)``: a state reaching function exit
+      (``how`` is "return" or "end").
+    """
+
+    _MAX_LOOP_PASSES = 8
+
+    def walk(self, fn: ast.AST) -> None:
+        out = self._body(list(fn.body), {frozenset()}, 0, None)
+        for state in out:
+            self.on_exit(fn, state, "end")
+
+    # -- hooks (defaults are no-ops) ----------------------------------------
+    def transfer(self, stmt: ast.stmt, state: frozenset) -> frozenset:
+        return state
+
+    def branch(self, test: ast.expr, state: frozenset):
+        return {state}, {state}
+
+    def try_protects(self, trystmt: ast.Try) -> bool:
+        return False
+
+    def may_raise(self, stmt: ast.stmt) -> bool:
+        return False
+
+    def on_await(self, stmt: ast.stmt, state: frozenset) -> None:
+        pass
+
+    def on_unprotected_raise(self, stmt: ast.stmt, state: frozenset) -> None:
+        pass
+
+    def on_exit(self, node: ast.AST, state: frozenset, how: str) -> None:
+        pass
+
+    # -- engine -------------------------------------------------------------
+    def _body(self, stmts, states: set, protected: int, loop: Optional[_LoopCtx]) -> set:
+        for stmt in stmts:
+            if not states:
+                return states
+            states = self._stmt(stmt, states, protected, loop)
+        return states
+
+    def _stmt(self, stmt, states: set, protected: int, loop) -> set:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return states  # nested scopes are walked separately
+        if isinstance(stmt, ast.If):
+            out: set = set()
+            for state in states:
+                true_states, false_states = self.branch(stmt.test, state)
+                out |= self._body(list(stmt.body), set(true_states), protected, loop)
+                if stmt.orelse:
+                    out |= self._body(
+                        list(stmt.orelse), set(false_states), protected, loop
+                    )
+                else:
+                    out |= set(false_states)
+            return out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, states, protected, loop)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, states, protected, loop)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # Item expressions behave like one simple statement (a synthetic
+            # Expr, so the body isn't double-walked), then the body runs in
+            # the same protection context.
+            items = ast.Expr(
+                value=ast.Tuple(
+                    elts=[item.context_expr for item in stmt.items],
+                    ctx=ast.Load(),
+                ),
+                lineno=stmt.lineno,
+                col_offset=stmt.col_offset,
+            )
+            states = self._simple(items, states, protected)
+            return self._body(list(stmt.body), states, protected, loop)
+        if isinstance(stmt, ast.Return):
+            states = self._simple(stmt, states, protected)
+            for state in states:
+                self.on_exit(stmt, state, "return")
+            return set()
+        if isinstance(stmt, ast.Break):
+            if loop is not None:
+                loop.breaks |= states
+            return set()
+        if isinstance(stmt, ast.Continue):
+            if loop is not None:
+                loop.continues |= states
+            return set()
+        if isinstance(stmt, ast.Raise):
+            if protected == 0:
+                for state in states:
+                    self.on_unprotected_raise(stmt, state)
+            return set()
+        return self._simple(stmt, states, protected)
+
+    def _simple(self, stmt, states: set, protected: int) -> set:
+        out = set()
+        has_await = any(isinstance(n, ast.Await) for n in ast.walk(stmt))
+        for state in states:
+            # The statement is treated atomically, and the raise/await check
+            # sees only tokens live BOTH before and after it: releases and
+            # handoffs inside the statement already closed theirs, and an
+            # acquisition inside a raising statement never happened.
+            new = self.transfer(stmt, state)
+            live = frozenset(set(state) & set(new))
+            if protected == 0:
+                if has_await:
+                    self.on_await(stmt, live)
+                elif self.may_raise(stmt):
+                    self.on_unprotected_raise(stmt, live)
+            out.add(new)
+        return out
+
+    def _loop(self, stmt, states: set, protected: int, outer) -> set:
+        lc = _LoopCtx()
+        if isinstance(stmt, ast.While):
+            entry = set()
+            for state in states:
+                true_states, false_states = self.branch(stmt.test, state)
+                entry |= set(true_states)
+                lc.breaks |= set(false_states)  # loop may run zero times
+        else:
+            entry = self._simple(
+                ast.Expr(value=stmt.iter, lineno=stmt.lineno, col_offset=0),
+                states,
+                protected,
+            )
+            lc.breaks |= entry  # zero iterations
+        seen = set(entry)
+        frontier = set(entry)
+        for _ in range(self._MAX_LOOP_PASSES):
+            if not frontier:
+                break
+            out = self._body(list(stmt.body), frontier, protected, lc)
+            out |= lc.continues
+            lc.continues = set()
+            if isinstance(stmt, ast.While):
+                nxt = set()
+                for state in out:
+                    true_states, false_states = self.branch(stmt.test, state)
+                    nxt |= set(true_states)
+                    lc.breaks |= set(false_states)
+            else:
+                nxt = out
+                lc.breaks |= out  # iterator exhausted
+            frontier = nxt - seen
+            seen |= nxt
+        after = set(lc.breaks)
+        if stmt.orelse:
+            after = self._body(list(stmt.orelse), after, protected, outer)
+        return after
+
+    def _try(self, stmt: ast.Try, states: set, protected: int, loop) -> set:
+        protecting = self.try_protects(stmt)
+        body_out = self._body(
+            list(stmt.body), set(states), protected + (1 if protecting else 0), loop
+        )
+        # Handler entry is approximated as "anywhere in the body": the union
+        # of the entry states and the body's exit states.
+        handler_entry = set(states) | body_out
+        after = set(body_out)
+        for handler in stmt.handlers:
+            after |= self._body(list(handler.body), set(handler_entry), protected, loop)
+        if stmt.orelse:
+            after = self._body(list(stmt.orelse), after, protected, loop)
+        if stmt.finalbody:
+            after = self._body(list(stmt.finalbody), after, protected, loop)
+        return after
+
+
+def iter_functions(tree: ast.AST):
+    """Every function definition in the file (module-level, methods, and
+    nested defs alike) — each is flow-walked independently."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
 def iter_py_files(root: str, rel_dir: str) -> List[str]:
     out = []
     for dirpath, _, filenames in os.walk(os.path.join(root, rel_dir)):
@@ -182,6 +433,8 @@ def default_context(root: str) -> AnalysisContext:
         # counter()/span() plumbing); the discipline passes gate its users.
         telemetry_exempt_prefixes=("torchsnapshot_tpu/telemetry/",),
         manifest_path="torchsnapshot_tpu/manifest.py",
+        io_types_path="torchsnapshot_tpu/io_types.py",
+        faults_path="torchsnapshot_tpu/faults.py",
     )
 
 
@@ -190,10 +443,13 @@ def get_passes():
     can list passes even if one module is mid-edit."""
     from . import (
         async_safety,
+        fault_coverage,
         knob_drift,
         manifest_schema,
+        resource_balance,
         task_leak,
         telemetry_discipline,
+        thread_safety,
     )
 
     return [
@@ -202,6 +458,9 @@ def get_passes():
         ("knob-drift", knob_drift.run),
         ("telemetry-discipline", telemetry_discipline.run),
         ("manifest-schema", manifest_schema.run),
+        ("resource-balance", resource_balance.run),
+        ("thread-safety", thread_safety.run),
+        ("fault-coverage", fault_coverage.run),
     ]
 
 
@@ -238,7 +497,9 @@ def write_baseline(path: str, findings: List[Finding]) -> None:
         "findings": sorted(f.baseline_id for f in findings),
     }
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=2)
+        # Sorted entries (above) + sorted keys: --update-baseline output is
+        # byte-deterministic, so baseline diffs review as pure adds/removes.
+        json.dump(data, f, indent=2, sort_keys=True)
         f.write("\n")
 
 

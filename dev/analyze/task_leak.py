@@ -1,10 +1,16 @@
-"""Pass 2 — task-leak (TSA201/TSA202).
+"""Pass 2 — task-leak (TSA201-TSA204).
 
 Every ``asyncio.ensure_future``/``create_task`` in the pipelines follows the
 scheduler's ``_reap`` pattern: the task is retained (dict key, list element,
 gathered) and its ``.result()`` is eventually read, so failures propagate.
 A discarded task object is garbage-collected mid-flight (Python cancels it)
 and its exception is silently dropped — the classic asyncio leak.
+
+``executor.submit(...)`` futures leak the same way with worse symptoms: a
+discarded ``concurrent.futures.Future`` is NOT cancelled by the GC — the
+worker runs to completion, its exception is stored on a dead object, and
+``ThreadPoolExecutor.shutdown`` happily waits for work nobody will ever
+collect (the PR 5 ``_reap`` bug was exactly this shape on the budget side).
 
 Codes:
 
@@ -14,6 +20,13 @@ Codes:
   it is not flagged).
 - **TSA202** — task-spawn result assigned to a name that is never read
   again in the enclosing scope: retained in name only, never reaped.
+- **TSA203** — ``*.submit(...)`` executor-future discarded (bare expression
+  statement): its exception is silently dropped and error paths cannot
+  cancel it.
+- **TSA204** — ``*.submit(...)`` future assigned to a name never read again
+  in the enclosing scope. Sanctioned collection idioms (``.result()``,
+  ``asyncio.wrap_future``, ``as_completed``/``wait``, ``.cancel()`` on
+  error paths) are all reads of the name, so they stay quiet.
 """
 
 from __future__ import annotations
@@ -21,17 +34,24 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from .core import AnalysisContext, Finding, dotted_name, parent_map
+from .core import AnalysisContext, Finding, dotted_name
 
 _SPAWN_NAMES = {"ensure_future", "create_task"}
 
 
-def _is_spawn(call: ast.Call) -> bool:
+def _spawn_kind(call: ast.Call) -> Optional[str]:
+    """"task" for ensure_future/create_task, "future" for *.submit, else
+    None. Bare ``submit`` names don't count — only method form, so unrelated
+    helpers named submit stay quiet."""
     name = dotted_name(call.func)
     if name is None:
-        return False
+        return None
     last = name.rsplit(".", 1)[-1]
-    return last in _SPAWN_NAMES
+    if last in _SPAWN_NAMES:
+        return "task"
+    if last == "submit" and "." in name:
+        return "future"
+    return None
 
 
 def _enclosing_scope(node: ast.AST, parents) -> Optional[ast.AST]:
@@ -69,24 +89,35 @@ def run(ctx: AnalysisContext) -> List[Finding]:
         tree = ctx.tree(relpath)
         if tree is None:
             continue
-        parents = parent_map(tree)
+        parents = ctx.parents(relpath)
         for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and _is_spawn(node)):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _spawn_kind(node)
+            if kind is None:
                 continue
             parent = parents.get(node)
             spawn = dotted_name(node.func)
             if isinstance(parent, ast.Expr):
+                if kind == "task":
+                    code, what = "TSA201", (
+                        "the task can be garbage-collected mid-flight and "
+                        "its exception is lost; retain and reap/await it "
+                        "(or chain .add_done_callback)"
+                    )
+                else:
+                    code, what = "TSA203", (
+                        "the executor future's exception is silently "
+                        "dropped and error paths cannot cancel it; retain "
+                        "it and collect .result() (or chain "
+                        ".add_done_callback)"
+                    )
                 findings.append(
                     Finding(
                         path=relpath,
                         line=node.lineno,
-                        code="TSA201",
-                        message=(
-                            f"`{spawn}(...)` result discarded: the task can "
-                            "be garbage-collected mid-flight and its "
-                            "exception is lost; retain and reap/await it "
-                            "(or chain .add_done_callback)"
-                        ),
+                        code=code,
+                        message=f"`{spawn}(...)` result discarded: {what}",
                         key=f"discard:{spawn}",
                     )
                 )
@@ -102,14 +133,20 @@ def run(ctx: AnalysisContext) -> List[Finding]:
                     continue
                 for tgt in targets:
                     if not _name_is_read(scope, tgt.id, parent):
+                        if kind == "task":
+                            code, noun = "TSA202", "task"
+                            how = "awaited/reaped"
+                        else:
+                            code, noun = "TSA204", "executor future"
+                            how = "collected (.result()/wrap_future)"
                         findings.append(
                             Finding(
                                 path=relpath,
                                 line=node.lineno,
-                                code="TSA202",
+                                code=code,
                                 message=(
-                                    f"task assigned to `{tgt.id}` is never "
-                                    "awaited/reaped in this scope; its "
+                                    f"{noun} assigned to `{tgt.id}` is "
+                                    f"never {how} in this scope; its "
                                     "failure would be silently dropped"
                                 ),
                                 key=f"leak:{tgt.id}",

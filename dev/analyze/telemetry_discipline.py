@@ -29,7 +29,7 @@ import fnmatch
 import re
 from typing import List, Optional, Set, Tuple
 
-from .core import AnalysisContext, Finding, dotted_name, parent_map
+from .core import AnalysisContext, Finding, dotted_name
 
 _CATALOG_RE = re.compile(
     r"analyzer:\s*telemetry-catalog-begin(?P<body>.*?)"
@@ -145,7 +145,7 @@ def run(ctx: AnalysisContext) -> List[Finding]:
         if tree is None:
             continue
         with_exprs = _with_context_exprs(tree)
-        parents = parent_map(tree)
+        parents = ctx.parents(relpath)
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue

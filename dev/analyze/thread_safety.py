@@ -1,0 +1,293 @@
+"""Pass 7 — cross-thread mutation discipline (TSA701/TSA702).
+
+The pipeline core deliberately mixes the asyncio event loop with worker
+threads: staging/serialize thunks, hash folds, and D2H lane resolves all run
+on executors while the loop mutates the same pipeline objects. State shared
+across that boundary must be either lock-guarded (``StageTimes``,
+``TransferLanes`` hold a ``threading.Lock``) or of a thread-safe type
+(``ProgressTracker``, queues). A plain attribute assigned from both sides is
+a data race the event-loop design otherwise makes easy to miss — the thread
+*looks* sequential from the coroutine that awaits it.
+
+Detection, per file:
+
+- **executor callables** are function defs (or lambdas) passed to
+  ``*.submit(...)``, ``loop.run_in_executor(...)``, ``asyncio.to_thread(...)``
+  or ``threading.Thread(target=...)`` — by name or inline;
+- an **attribute write** is an ``Assign``/``AugAssign`` whose target is an
+  attribute (``self.x = ...``, ``obj.x += ...``);
+- a write is **guarded** when an enclosing ``with`` item's context
+  expression mentions a lock (dotted name whose last segment contains
+  ``lock``, e.g. ``with self._lock:``).
+
+Codes:
+
+- **TSA701** — an attribute assigned both inside an executor callable and
+  in loop-side code (outside ``__init__``), with at least one side
+  unguarded. Attributes initialized from an allowlisted thread-safe
+  constructor (``ProgressTracker``, ``StageTimes``, ``Queue``, ``deque``,
+  ``Lock``/``RLock``/``Condition``/``Semaphore``/``Event``,
+  ``ThreadPoolExecutor``, ``Counter``) are exempt — mutating *through* such
+  objects is method calls, which this pass never flags.
+- **TSA702** — a ``nonlocal`` name rebound inside an executor callable that
+  is also bound in the enclosing loop-side scope, unguarded (the closure
+  analogue of TSA701).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import AnalysisContext, Finding, dotted_name
+
+_SUBMIT_SUFFIXES = ("submit", "to_thread")
+_RUN_IN_EXECUTOR = "run_in_executor"
+
+_THREAD_SAFE_CTORS = {
+    "ProgressTracker",
+    "StageTimes",
+    "Queue",
+    "SimpleQueue",
+    "LifoQueue",
+    "PriorityQueue",
+    "deque",
+    "Counter",
+    "Event",
+    "Lock",
+    "RLock",
+    "Condition",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "ThreadPoolExecutor",
+    "TransferLanes",
+}
+
+
+def _callable_args(call: ast.Call) -> List[ast.expr]:
+    """The argument positions that name the submitted callable."""
+    name = dotted_name(call.func)
+    last = None
+    if name is not None:
+        last = name.rsplit(".", 1)[-1]
+    elif isinstance(call.func, ast.Attribute):
+        last = call.func.attr
+    if last is None:
+        return []
+    if last == _RUN_IN_EXECUTOR:
+        # loop.run_in_executor(executor, fn, *args)
+        return call.args[1:2]
+    if last in _SUBMIT_SUFFIXES:
+        return call.args[:1]
+    if last == "Thread":
+        return [kw.value for kw in call.keywords if kw.arg == "target"]
+    return []
+
+
+class _Write:
+    __slots__ = ("attr", "line", "in_executor", "guarded", "fn_name")
+
+    def __init__(self, attr, line, in_executor, guarded, fn_name) -> None:
+        self.attr = attr
+        self.line = line
+        self.in_executor = in_executor
+        self.guarded = guarded
+        self.fn_name = fn_name
+
+
+def _is_lock_item(expr: ast.expr) -> bool:
+    name = dotted_name(expr)
+    if name is None:
+        if isinstance(expr, ast.Call):
+            return _is_lock_item(expr.func)
+        return False
+    return "lock" in name.rsplit(".", 1)[-1].lower()
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in ctx.lib_files:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        parents = ctx.parents(relpath)
+
+        # 1. Names (and inline defs) submitted to executors/threads.
+        submitted_names: Set[str] = set()
+        inline_defs: Set[ast.AST] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg in _callable_args(node):
+                if isinstance(arg, ast.Name):
+                    submitted_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    inline_defs.add(arg)
+
+        executor_fns: Set[ast.AST] = set(inline_defs)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in submitted_names
+            ):
+                executor_fns.add(node)
+        if not executor_fns:
+            continue
+
+        def enclosing_info(node) -> Dict[str, object]:
+            """(is the node inside an executor callable?, is it guarded by a
+            lock `with`?, the name of its directly-enclosing function)"""
+            in_executor = False
+            guarded = False
+            fn_name: Optional[str] = None
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.With, ast.AsyncWith)) and any(
+                    _is_lock_item(item.context_expr) for item in cur.items
+                ):
+                    guarded = True
+                if isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    if fn_name is None and not isinstance(cur, ast.Lambda):
+                        fn_name = cur.name
+                    if cur in executor_fns:
+                        in_executor = True
+                cur = parents.get(cur)
+            return {
+                "in_executor": in_executor,
+                "guarded": guarded,
+                "fn_name": fn_name or "<module>",
+            }
+
+        # 2. Attribute writes + thread-safe-typed attributes.
+        writes: List[_Write] = []
+        safe_attrs: Set[str] = set()
+        for node in ast.walk(tree):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                # `self.x = Queue()` marks x as an allowlisted type.
+                if isinstance(node.value, ast.Call):
+                    ctor = dotted_name(node.value.func)
+                    if (
+                        ctor is not None
+                        and ctor.rsplit(".", 1)[-1] in _THREAD_SAFE_CTORS
+                    ):
+                        for t in targets:
+                            if isinstance(t, ast.Attribute):
+                                safe_attrs.add(t.attr)
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                info = enclosing_info(node)
+                writes.append(
+                    _Write(
+                        t.attr,
+                        node.lineno,
+                        info["in_executor"],
+                        info["guarded"],
+                        info["fn_name"],
+                    )
+                )
+
+        by_attr: Dict[str, List[_Write]] = {}
+        for w in writes:
+            by_attr.setdefault(w.attr, []).append(w)
+        for attr, ws in sorted(by_attr.items()):
+            if attr in safe_attrs:
+                continue
+            executor_ws = [w for w in ws if w.in_executor]
+            loop_ws = [
+                w for w in ws if not w.in_executor and w.fn_name != "__init__"
+            ]
+            if not executor_ws or not loop_ws:
+                continue
+            unguarded = [w for w in executor_ws + loop_ws if not w.guarded]
+            if not unguarded:
+                continue
+            w = min(executor_ws, key=lambda w: w.line)
+            other = min(loop_ws, key=lambda w: w.line)
+            findings.append(
+                Finding(
+                    path=relpath,
+                    line=w.line,
+                    code="TSA701",
+                    message=(
+                        f"attribute `{attr}` is assigned from an "
+                        f"executor-submitted callable (`{w.fn_name}`, line "
+                        f"{w.line}) AND from loop-side code (line "
+                        f"{other.line}) without a lock on both sides; guard "
+                        "both writes with a lock or use a thread-safe type"
+                    ),
+                    key=f"xthread:{attr}",
+                )
+            )
+
+        # 3. nonlocal rebinding from executor callables (TSA702).
+        for fn in executor_fns:
+            if isinstance(fn, ast.Lambda):
+                continue
+            nonlocals: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Nonlocal):
+                    nonlocals.update(node.names)
+            if not nonlocals:
+                continue
+            assigned_here: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in tgts:
+                        if isinstance(t, ast.Name) and t.id in nonlocals:
+                            assigned_here.add(t.id)
+            if not assigned_here:
+                continue
+            # The enclosing (loop-side) function: does it bind them too?
+            encl = parents.get(fn)
+            while encl is not None and not isinstance(
+                encl, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                encl = parents.get(encl)
+            if encl is None:
+                continue
+            for node in ast.walk(encl):
+                if node is fn or not isinstance(
+                    node, (ast.Assign, ast.AugAssign)
+                ):
+                    continue
+                info = enclosing_info(node)
+                if info["in_executor"] or info["guarded"]:
+                    continue
+                tgts = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in tgts:
+                    if isinstance(t, ast.Name) and t.id in assigned_here:
+                        findings.append(
+                            Finding(
+                                path=relpath,
+                                line=fn.lineno,
+                                code="TSA702",
+                                message=(
+                                    f"nonlocal `{t.id}` is rebound inside "
+                                    f"executor-submitted `{fn.name}` and "
+                                    "also assigned on the loop side (line "
+                                    f"{node.lineno}) without a lock"
+                                ),
+                                key=f"nonlocal:{fn.name}:{t.id}",
+                            )
+                        )
+                        assigned_here.discard(t.id)
+    return findings

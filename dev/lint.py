@@ -13,7 +13,8 @@ box:
   ``benchmarks/gen_tables.py --check``), so a driver-recorded regression can
   never stay invisible in the human-facing docs;
 - the checkpoint-invariant static analyzer (``dev/analyze``: async-safety,
-  task-leak, knob/telemetry drift, manifest schema — see
+  task/future leaks, knob/telemetry drift, manifest schema, flow-sensitive
+  resource balance, cross-thread mutation, fault-injection coverage — see
   ``docs/static-analysis.md``) over the library package.
 
     python dev/lint.py            # lint + analyze the repo
@@ -128,10 +129,9 @@ def fix_file(path: str) -> bool:
 
 
 def check_analyzer(paths: list) -> int:
-    """The static-analysis gate (``python -m dev.analyze``): async-safety,
-    task-leak, knob/telemetry drift, manifest schema. Subprocess so the
-    analyzer's import path (repo root) never depends on how lint was
-    invoked."""
+    """The static-analysis gate (``python -m dev.analyze``): all eight
+    passes (see dev/analyze/__init__.py). Subprocess so the analyzer's
+    import path (repo root) never depends on how lint was invoked."""
     import subprocess
 
     cmd = [sys.executable, "-m", "dev.analyze", *paths]

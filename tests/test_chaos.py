@@ -44,6 +44,17 @@ from torchsnapshot_tpu.test_utils import run_with_processes
 from torchsnapshot_tpu.utils import knobs
 
 
+@pytest.fixture(autouse=True)
+def _debug_ledger():
+    """The whole chaos harness runs under the budget-ledger sanitizer
+    (TORCHSNAPSHOT_TPU_DEBUG_LEDGER=1, inherited by child ranks): every
+    aborted pipeline must leave zero outstanding budget bytes, with any
+    leak attributed to its debiting site — the runtime cross-check of the
+    static TSA6xx resource-balance pass."""
+    with knobs.override_debug_ledger(True):
+        yield
+
+
 # ---------------------------------------------------------------------------
 # Backend plumbing. Inspection (listing, metadata probes) always goes through
 # a PRISTINE plugin (_resolve_storage_plugin: no fault wrapper), so the

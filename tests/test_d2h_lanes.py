@@ -28,6 +28,14 @@ except ImportError:  # pragma: no cover - ships with jax
     ml_dtypes = None
 
 
+@pytest.fixture(autouse=True)
+def _debug_ledger():
+    """Lane-window accounting runs under the budget-ledger sanitizer:
+    close/abort assert zero outstanding bytes with site attribution."""
+    with knobs.override_debug_ledger(True):
+        yield
+
+
 def _run(coro):
     loop = asyncio.new_event_loop()
     try:

@@ -26,6 +26,15 @@ from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
 from torchsnapshot_tpu.utils import knobs
 
 
+@pytest.fixture(autouse=True)
+def _debug_ledger():
+    """The whole scheduler suite runs under the budget-ledger sanitizer:
+    every pipeline asserts zero outstanding bytes at close/abort, naming
+    leaking sites — the runtime cross-check of the TSA6xx static pass."""
+    with knobs.override_debug_ledger(True):
+        yield
+
+
 class TrackingStager(BufferStager):
     live = 0
     peak = 0

@@ -200,6 +200,64 @@ def test_task_leak_quiet_on_reaped_idioms(tmp_path):
     assert run_passes(ctx) == []
 
 
+def test_task_leak_flags_discarded_and_unreaped_executor_futures(tmp_path):
+    # The TSA2xx extension to concurrent.futures: the PR 5 `_reap` bug shape
+    # was exactly a spawned unit of work whose failure nobody collected.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            def discarded(pool, job):
+                pool.submit(job)
+
+            def unreaped(pool, job):
+                fut = pool.submit(job)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA203", "TSA204"]
+
+
+def test_task_leak_quiet_on_collected_executor_futures(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            def collected(pool, job):
+                fut = pool.submit(job)
+                return fut.result()
+
+            async def wrapped(pool, job):
+                fut = pool.submit(job)
+                return await asyncio.wrap_future(fut)
+
+            def cancelled_on_error(pool, jobs):
+                futs = [pool.submit(j) for j in jobs]
+                try:
+                    return [f.result() for f in futs]
+                except Exception:
+                    for f in futs:
+                        f.cancel()
+                    raise
+
+            def chained(pool, job, handler):
+                pool.submit(job).add_done_callback(handler)
+
+            def submit(x):
+                # A bare function named `submit` is not an executor call.
+                pass
+
+            def uses_bare_submit(x):
+                submit(x)
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
 # ---------------------------------------------------------------------------
 # Pass 3: knob-registry drift
 # ---------------------------------------------------------------------------
@@ -417,8 +475,407 @@ def test_manifest_schema_allows_nested_schema_classes(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass 6: resource balance (flow-sensitive)
+# ---------------------------------------------------------------------------
+
+
+def test_resource_balance_flags_await_between_debit_and_protection(tmp_path):
+    # The PR 5 regression shape: the reservation is balanced on the happy
+    # path, but cancellation (or a failure) at the await strands it.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            async def admit_and_wait(self, req):
+                cost = req.cost
+                self.budget.debit(cost)
+                buf = await req.stage()
+                self.budget.credit(cost)
+                return buf
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA602"]
+    assert "cancellation" in found[0].message
+
+
+def test_resource_balance_flags_early_return_and_raise_paths(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            def early_return(self, cost, hurry):
+                self.budget.debit(cost)
+                if hurry:
+                    return None
+                self.budget.credit(cost)
+
+            def unprotected_raise(self, cost, req):
+                self.budget.debit(cost)
+                validate(req)
+                self.budget.credit(cost)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA601", "TSA601"]
+
+
+def test_resource_balance_flags_stranded_window_admission(tmp_path):
+    # The PR 6 regression shape: an admitted look-ahead window reservation
+    # with no release on the failure path.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            async def lookahead(lanes, est, arr):
+                if not lanes.try_admit(est):
+                    return None
+                host = await resolve(arr)
+                lanes.release(est)
+                return host
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA602"]
+    assert "window admission" in found[0].message
+
+
+def test_resource_balance_quiet_on_sanctioned_idioms(tmp_path):
+    # The scheduler's real shapes: try/finally protection, task-table
+    # handoff, ledger-counter accumulation, and the lane pump's
+    # admit-then-append-to-owning-deque.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            async def protected(self, cost, req):
+                self.budget.debit(cost)
+                try:
+                    buf = await req.stage()
+                finally:
+                    self.budget.credit(cost)
+                return buf
+
+            def handed_to_task_table(self, req, cost, task):
+                self.budget.debit(cost)
+                self.staging_tasks[task] = (req, cost)
+
+            async def counter_ledger(self, budget, chunk_est, agen):
+                outstanding = 0
+                try:
+                    while True:
+                        budget.debit(chunk_est)
+                        outstanding += chunk_est
+                        buf = await agen.next()
+                        if buf is None:
+                            break
+                finally:
+                    if outstanding:
+                        budget.credit(outstanding)
+
+            def pump(lanes, ranges, row_bytes, pending, arr):
+                for r0, r1 in ranges:
+                    est = (r1 - r0) * row_bytes
+                    if not lanes.try_admit(est, force=not pending):
+                        break
+                    pending.append((arr[r0:r1], est))
+
+            def estimate_correction(self, cost, buf):
+                nbytes = memoryview(buf).nbytes
+                self.budget.credit(cost)
+                self.budget.debit(nbytes)
+                self.ready_for_io.append((self.path, buf))
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+def test_resource_balance_quiet_when_except_credits(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            async def credits_on_error(self, cost, req):
+                self.budget.debit(cost)
+                try:
+                    buf = await req.stage()
+                except BaseException:
+                    self.budget.credit(cost)
+                    raise
+                self.handoff[req.path] = (buf, cost)
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 7: cross-thread mutation
+# ---------------------------------------------------------------------------
+
+
+def test_thread_safety_flags_unguarded_cross_thread_attribute(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            class Pipeline:
+                def __init__(self):
+                    self.bytes_done = 0
+
+                async def drain(self, executor, chunk):
+                    def work():
+                        self.bytes_done += chunk.nbytes
+                        return chunk
+
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(executor, work)
+
+                def reset(self):
+                    self.bytes_done = 0
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA701"]
+    assert "bytes_done" in found[0].message
+
+
+def test_thread_safety_quiet_on_locks_and_safe_types(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+            import threading
+            from queue import Queue
+
+            class Guarded:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    self.results = Queue()
+
+                async def drain(self, executor, chunk):
+                    def work():
+                        with self._lock:
+                            self.count += 1
+                        self.results = Queue()
+                        return chunk
+
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(executor, work)
+
+                def reset(self):
+                    with self._lock:
+                        self.count = 0
+                    self.results = Queue()
+
+                def method_calls_are_fine(self, tracker):
+                    # Mutating THROUGH a thread-safe object is method calls,
+                    # which the pass never flags.
+                    tracker.note_staged(1)
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+def test_thread_safety_flags_nonlocal_rebinding(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            async def tally(executor, chunks):
+                total = 0
+
+                def work(c):
+                    nonlocal total
+                    total += c.nbytes
+
+                loop = asyncio.get_running_loop()
+                for c in chunks:
+                    await loop.run_in_executor(executor, work, c)
+                total = -1
+                return total
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA702"]
+
+
+# ---------------------------------------------------------------------------
+# Pass 8: fault-injection coverage
+# ---------------------------------------------------------------------------
+
+_CONTRACT = """
+import abc
+
+
+class StoragePlugin(abc.ABC):
+    async def write(self, write_io):
+        ...
+
+    async def read(self, read_io):
+        ...
+
+    async def list_prefix(self, prefix):
+        ...
+
+    async def close(self):
+        ...
+"""
+
+
+def _fault_ctx(tmp_path, faults_src):
+    return make_ctx(
+        tmp_path,
+        {"pkg/io_types.py": _CONTRACT, "pkg/faults.py": faults_src},
+        io_types_path="pkg/io_types.py",
+        faults_path="pkg/faults.py",
+    )
+
+
+def test_fault_coverage_flags_unwrapped_and_unguarded_ops(tmp_path):
+    ctx = _fault_ctx(
+        tmp_path,
+        """
+        _OPS = ("write", "read", "list")
+        _PASSTHROUGH_OPS = ("close",)
+
+
+        class FaultyStoragePlugin:
+            async def write(self, write_io):
+                await self._guard("write", write_io.path)
+                await self.inner.write(write_io)
+
+            async def list_prefix(self, prefix):
+                # un-guarded proxy, not declared passthrough
+                return await self.inner.list_prefix(prefix)
+
+            async def close(self):
+                await self.inner.close()
+        """,
+    )
+    found = run_passes(ctx)
+    # read has no override at all; list_prefix proxies without _guard.
+    assert codes(found) == ["TSA801", "TSA802"]
+    by_code = {f.code: f for f in found}
+    assert "read" in by_code["TSA801"].message
+    assert "list_prefix" in by_code["TSA802"].message
+
+
+def test_fault_coverage_flags_typoed_guard_op(tmp_path):
+    ctx = _fault_ctx(
+        tmp_path,
+        """
+        _OPS = ("write", "read", "list")
+        _PASSTHROUGH_OPS = ("close",)
+
+
+        class FaultyStoragePlugin:
+            async def write(self, write_io):
+                await self._guard("writ", write_io.path)
+                await self.inner.write(write_io)
+
+            async def read(self, read_io):
+                await self._guard("read", read_io.path)
+                await self.inner.read(read_io)
+
+            async def list_prefix(self, prefix):
+                await self._guard("list", prefix)
+                return await self.inner.list_prefix(prefix)
+
+            async def close(self):
+                await self.inner.close()
+        """,
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA803"]
+    assert "writ" in found[0].message
+
+
+def test_fault_coverage_quiet_when_surface_fully_wrapped(tmp_path):
+    ctx = _fault_ctx(
+        tmp_path,
+        """
+        _OPS = ("write", "read", "list")
+        _PASSTHROUGH_OPS = ("close",)
+
+
+        class FaultyStoragePlugin:
+            async def write(self, write_io):
+                await self._guard("write", write_io.path)
+                await self.inner.write(write_io)
+
+            async def read(self, read_io):
+                await self._guard("read", read_io.path)
+                await self.inner.read(read_io)
+
+            async def list_prefix(self, prefix):
+                await self._guard("list", prefix)
+                return await self.inner.list_prefix(prefix)
+
+            async def close(self):
+                await self.inner.close()
+        """,
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline mechanics
 # ---------------------------------------------------------------------------
+
+
+def test_baseline_written_deterministically(tmp_path):
+    """--update-baseline output is byte-stable regardless of finding order,
+    so baseline diffs review as pure adds/removes."""
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+
+            async def a():
+                time.sleep(1)
+
+            async def b():
+                time.sleep(2)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert len(found) == 2
+    p1, p2 = str(tmp_path / "b1.json"), str(tmp_path / "b2.json")
+    write_baseline(p1, found)
+    write_baseline(p2, list(reversed(found)))
+    assert open(p1).read() == open(p2).read()
+
+
+def test_unreadable_file_is_single_one_line_finding(tmp_path):
+    """A missing/unreadable analyzed file yields one TSA000 finding (the
+    CLI contract: file:line, never a traceback)."""
+    ctx = AnalysisContext(root=str(tmp_path), lib_files=["nope.py"])
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA000"]
+    assert found[0].path == "nope.py"
+    assert "not readable" in found[0].message
+
+
+def test_ast_and_parent_map_are_parsed_once_and_shared(tmp_path):
+    ctx = make_ctx(tmp_path, {"mod.py": "x = 1\n"})
+    assert ctx.tree("mod.py") is ctx.tree("mod.py")
+    assert ctx.parents("mod.py") is ctx.parents("mod.py")
 
 
 def test_baseline_grandfathers_and_detects_stale(tmp_path):

@@ -216,6 +216,17 @@ class TransferLanes:
             self._outstanding = 0
         if n and self._on_release is not None:
             self._on_release(n)
+        if n:
+            from .utils import knobs
+
+            if knobs.is_debug_ledger_enabled():
+                # Sanitizer witness: the sweep doing real work means some
+                # stream was cancelled before its own cleanup ran — expected
+                # on hard aborts, but worth a line when ledger-auditing.
+                logger.info(
+                    "d2h lane sweep released %d stranded look-ahead bytes",
+                    n,
+                )
         return n
 
     def start(

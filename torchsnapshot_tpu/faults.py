@@ -100,6 +100,14 @@ _OPS = (
 )
 _KINDS = ("transient", "fail", "torn", "stall", "kill")
 
+# Plugin surface the wrapper deliberately proxies WITHOUT an injection
+# point: non-data-plane housekeeping where a fault proves nothing about
+# crash consistency. The TSA8xx fault-coverage analyzer pass reads this
+# tuple — any other un-guarded override (and any contract method with no
+# override at all) fails the gate, so new plugin surface can never silently
+# bypass chaos testing.
+_PASSTHROUGH_OPS = ("prune_empty", "close")
+
 # Exit code of a `kill` fault — distinctive so the chaos harness (and a
 # human reading a CI log) can tell an injected death from a real crash.
 KILL_EXIT_CODE = 87

@@ -257,8 +257,12 @@ class ArrayBufferStager(BufferStager):
                             path=location, nbytes=len(payload),
                         )
                     # Publish for the companion FrameTableStager (same
-                    # pipeline, polls until this lands).
-                    self.frame_sizes = sizes
+                    # pipeline, polls until this lands). Cross-thread by
+                    # design: a single atomic reference store, and the
+                    # loop-side assignment in stage_chunks is a mutually
+                    # exclusive path (a request stages whole OR streamed,
+                    # never both).
+                    self.frame_sizes = sizes  # noqa: TSA701
                     return payload
 
                 if executor is not None:

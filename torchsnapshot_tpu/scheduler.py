@@ -49,7 +49,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import d2h, telemetry
+from . import d2h, ledger, telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .utils import knobs
 
@@ -257,20 +257,35 @@ class PipelinePools:
 
 
 class _Budget:
-    def __init__(self, total: int) -> None:
+    def __init__(self, total: int, owner: str = "pipeline") -> None:
         self.total = total
         self.available = total
         # Lowest availability seen — the budget high-water mark
         # (total - min_available) is a telemetry gauge at pipeline end.
         self.min_available = total
+        # Debug-mode sanitizer (TORCHSNAPSHOT_TPU_DEBUG_LEDGER): journals
+        # every debit with its owner/call-site so assert_balanced can name
+        # leaking sites. None in production — the hot path stays two adds.
+        self.ledger = ledger.maybe_ledger(owner)
 
     def debit(self, n: int) -> None:
         self.available -= n
         if self.available < self.min_available:
             self.min_available = self.available
+        if self.ledger is not None:
+            self.ledger.record_debit(n)
 
     def credit(self, n: int) -> None:
         self.available += n
+        if self.ledger is not None:
+            self.ledger.record_credit(n)
+
+    def assert_balanced(self, context: str) -> None:
+        """Ledger-mode assertion that every debit has been credited back —
+        called at pipeline close and on every abort path. No-op (and no
+        allocation) unless the debug-ledger knob is set."""
+        if self.ledger is not None:
+            self.ledger.assert_balanced(context)
 
     @property
     def high_water_bytes(self) -> int:
@@ -354,7 +369,7 @@ class _WritePipeline:
         self.bytes_deduped = 0
         self.rank = rank
         self.begin_ts = time.monotonic()
-        self.budget = _Budget(memory_budget_bytes)
+        self.budget = _Budget(memory_budget_bytes, owner=f"write@rank{rank}")
         # Live progress counters (PendingSnapshot.progress()): totals start
         # as staging-cost estimates and converge on actual bytes as staging
         # completes, so bytes_written ends equal to the payload total.
@@ -534,7 +549,10 @@ class _WritePipeline:
             if over_budget and not pipeline_empty:
                 break
             self.pending.popleft()
-            self.budget.debit(cost)
+            # Debit only once the task object exists, immediately before the
+            # task-table handoff: if coroutine construction raises, no
+            # reservation has been made yet, so nothing can leak (the task
+            # tables are what _reap/_abort_inflight sweep credits from).
             if stream:
                 # `started` marks whether the coroutine ever ran: an abort
                 # that cancels a never-started stream must credit its
@@ -544,11 +562,13 @@ class _WritePipeline:
                 task = asyncio.ensure_future(
                     self._stream_one(req, cost, started)
                 )
+                self.budget.debit(cost)
                 self.stream_tasks[task] = (req, time.monotonic(), cost, started)
             else:
                 task = asyncio.ensure_future(
                     req.buffer_stager.stage_buffer(self.executor)
                 )
+                self.budget.debit(cost)
                 self.staging_tasks[task] = (req, cost, time.monotonic())
 
     def _dispatch_io(self) -> None:
@@ -877,6 +897,10 @@ class _WritePipeline:
         # themselves (their cleanup normally does) — sweep the remainder so
         # the budget balances on every failure path.
         self._staging_ctx.lanes.release_all()
+        # Debug-ledger cross-check: an aborted pipeline must leave zero
+        # outstanding bytes; a leak here raises naming the debiting sites
+        # (chained onto the failure that triggered the abort).
+        self.budget.assert_balanced("write pipeline abort")
 
     def _reap(self, done) -> None:
         for task in done:
@@ -1048,6 +1072,10 @@ class _WritePipeline:
             raise
         await self._reap_watchdog(watchdog_task)
         self._shutdown_executor()
+        # Debug-ledger cross-check: a completed drain has credited every
+        # debit (request admissions, streamed chunks, lane-window
+        # look-ahead) — zero outstanding bytes at pipeline close.
+        self.budget.assert_balanced("write pipeline close")
 
         drain_window = (drain_t0, time.monotonic())
         self._windows.append(drain_window)
@@ -1305,7 +1333,7 @@ async def execute_read_reqs(
     pools: Optional[PipelinePools] = None,
 ) -> None:
     begin_ts = time.monotonic()
-    budget = _Budget(memory_budget_bytes)
+    budget = _Budget(memory_budget_bytes, owner=f"read@rank{rank}")
     pending: Deque[ReadReq] = deque(
         sorted(read_reqs, key=lambda r: -r.buffer_consumer.get_consuming_cost_bytes())
     )
@@ -1335,12 +1363,11 @@ async def execute_read_reqs(
             if over_budget and not pipeline_empty:
                 break
             req = pending.popleft()
+            # Task first, debit second (see _dispatch_staging_inner): a
+            # failed coroutine construction must not strand a reservation.
+            task = asyncio.ensure_future(read_one(req))
             budget.debit(cost)
-            io_tasks[asyncio.ensure_future(read_one(req))] = (
-                req,
-                cost,
-                time.monotonic(),
-            )
+            io_tasks[task] = (req, cost, time.monotonic())
 
     try:
         dispatch_reads()
@@ -1407,10 +1434,13 @@ async def execute_read_reqs(
         io_tasks.clear()
         consume_tasks.clear()
         pools.shutdown(cancel_queued=True)
+        # Debug-ledger cross-check (chains onto the original failure).
+        budget.assert_balanced("read pipeline abort")
         raise
     else:
         if owns_pools:
             pools.shutdown()
+        budget.assert_balanced("read pipeline close")
 
     elapsed = time.monotonic() - begin_ts
     telemetry.counter_add("scheduler.bytes_read", bytes_read)

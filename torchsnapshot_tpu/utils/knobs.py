@@ -719,6 +719,24 @@ def set_coordinator_env(store_addr: str, rank: int, world_size: int) -> None:
     os.environ[_ENV_WORLD_SIZE] = str(world_size)
 
 
+_ENV_DEBUG_LEDGER = "TORCHSNAPSHOT_TPU_DEBUG_LEDGER"
+
+
+def is_debug_ledger_enabled() -> bool:
+    """Debug-mode budget-ledger sanitizer: when set, every pipeline memory
+    budget journals each debit with its owner/call-site and asserts ZERO
+    outstanding bytes at pipeline close and on every abort path, raising a
+    ``LedgerLeakError`` that names the leaking sites (see ``ledger.py`` and
+    ``docs/robustness.md``). The runtime cross-check of the static TSA6xx
+    resource-balance pass; enabled across the chaos matrix and the
+    d2h/scheduler suites in CI. Off (the default) allocates nothing."""
+    return os.environ.get(_ENV_DEBUG_LEDGER, "") not in ("", "0", "false", "False")
+
+
+def override_debug_ledger(enabled: bool):
+    return _override_env(_ENV_DEBUG_LEDGER, "1" if enabled else "0")
+
+
 _ENV_FAULTS = "TORCHSNAPSHOT_TPU_FAULTS"
 
 
