@@ -68,13 +68,20 @@ def build_params(total_gb: float, seed: int = 0):
     return params, nbytes
 
 
-def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dict:
-    """Fail-soft regression gate: compare this run's drain wall and
-    drain_vs_link against the BEST prior BENCH_r0*.json taken on the same
-    workload (matched by detail.size_gb). Never raises and never aborts the
-    bench — the link itself drifts run to run — but a >10% drain-wall
-    regression or a >0.05 drain_vs_link drop is logged loudly and recorded
-    in the emitted JSON so the trajectory can't regress silently."""
+def regression_gate(
+    size_gb: float,
+    drain_s: float,
+    drain_vs_link: float,
+    restore_s: float = 0.0,
+) -> dict:
+    """Fail-soft regression gate: compare this run's drain wall,
+    drain_vs_link, AND restore wall against the BEST prior BENCH_r0*.json
+    taken on the same workload (matched by detail.size_gb). Never raises
+    and never aborts the bench — the link itself drifts run to run — but a
+    >10% drain-wall or restore-wall regression or a >0.05 drain_vs_link
+    drop is logged loudly and recorded in the emitted JSON so the
+    trajectory can't regress silently. Priors that predate restore timing
+    simply don't constrain it."""
     import glob
 
     priors = []
@@ -90,6 +97,7 @@ def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dic
                     path,
                     float(det["background_drain_s"]),
                     float(det.get("drain_vs_link", 0.0)),
+                    float((det.get("restore") or {}).get("wall_s", 0.0)),
                 )
             )
         except Exception:
@@ -98,6 +106,8 @@ def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dic
         return {"status": "no_prior", "priors": 0}
     best_drain_s = min(p[1] for p in priors)
     best_vs_link = max(p[2] for p in priors)
+    restore_priors = [p[3] for p in priors if p[3] > 0]
+    best_restore_s = min(restore_priors) if restore_priors else 0.0
     problems = []
     if drain_s > best_drain_s * 1.10:
         problems.append(
@@ -109,6 +119,11 @@ def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dic
             f"drain_vs_link {drain_vs_link:.2f} dropped more than 0.05 "
             f"below the best prior {best_vs_link:.2f}"
         )
+    if restore_s > 0 and best_restore_s > 0 and restore_s > best_restore_s * 1.10:
+        problems.append(
+            f"restore wall {restore_s:.2f}s is >10% over the best prior "
+            f"{best_restore_s:.2f}s"
+        )
     for p in problems:
         log(f"WARNING: bench regression gate: {p}")
     return {
@@ -116,6 +131,7 @@ def regression_gate(size_gb: float, drain_s: float, drain_vs_link: float) -> dic
         "priors": len(priors),
         "best_prior_drain_s": round(best_drain_s, 2),
         "best_prior_drain_vs_link": round(best_vs_link, 2),
+        "best_prior_restore_s": round(best_restore_s, 2),
         "problems": problems,
     }
 
@@ -492,12 +508,6 @@ def main() -> None:
         except Exception as e:  # diagnostics must never fail the bench
             log(f"WARNING: telemetry artifact aggregation failed: {e!r}")
 
-        # ---- fail-soft regression gate vs the best prior round on this
-        # workload (same size_gb): drain wall and drain_vs_link must not
-        # silently regress the way rounds 2→5 did.
-        gate = regression_gate(round(gb, 2), drain_s, drain_vs_link)
-        log(f"regression gate: {gate}")
-
         # ---- restore bit-exactness via random access into the async ckpt
         snap = Snapshot(os.path.join(root, "ckpt_async"))
         probe = list(params)[-1]
@@ -511,6 +521,31 @@ def main() -> None:
         log(f"restore bit-exact: {ok}")
         if not ok:
             raise SystemExit("restore mismatch")
+
+        # ---- restore wall (serving-side regression surface): a full
+        # cold restore of the checkpoint into fresh host targets, with the
+        # read-pipeline stats the restore path now reports
+        # (snapshot.LAST_RESTORE_STATS).
+        restore_sd = StateDict()
+        t0 = time.perf_counter()
+        Snapshot(os.path.join(root, "ckpt_async")).restore({"model": restore_sd})
+        restore_s = time.perf_counter() - t0
+        del restore_sd
+        restore_record = {
+            "wall_s": round(restore_s, 3),
+            "gbps": round(gb / max(restore_s, 1e-9), 4),
+        }
+        for k in ("bytes_read", "read_wall_s", "requests"):
+            v = snapshot_mod.LAST_RESTORE_STATS.get(k)
+            if v is not None:
+                restore_record[k] = round(float(v), 4)
+        log(f"full restore: {restore_record}")
+
+        # ---- fail-soft regression gate vs the best prior round on this
+        # workload (same size_gb): drain wall, drain_vs_link, and restore
+        # wall must not silently regress the way rounds 2→5 did.
+        gate = regression_gate(round(gb, 2), drain_s, drain_vs_link, restore_s)
+        log(f"regression gate: {gate}")
 
         print(
             json.dumps(
@@ -542,6 +577,7 @@ def main() -> None:
                         "naive_gbps_all": [round(r, 4) for r in naive_rates],
                         "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
                         "restore_bit_exact": ok,
+                        "restore": restore_record,
                         "telemetry": telemetry_summary,
                         # Environment fingerprint: every TORCHSNAPSHOT_TPU_*
                         # knob in effect, plus an explicit record that fault

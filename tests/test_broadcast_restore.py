@@ -1,0 +1,162 @@
+"""Broadcast restore (bcast.py): single-reader + store-broadcast fan-out.
+
+The multiprocess test asserts the headline property — every replicated
+object is read from origin storage by EXACTLY one rank, the rest receive
+its bytes over the coordinator store — plus bit-exactness and the knob
+gates. Unit tests cover election stability, SPMD-pure eligibility, and the
+fully-replicated-sharding helper.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import bcast
+from torchsnapshot_tpu.manifest import (
+    ArrayEntry,
+    ObjectEntry,
+    Shard,
+    ShardedArrayEntry,
+)
+from torchsnapshot_tpu.test_utils import run_with_processes
+from torchsnapshot_tpu.utils import knobs
+
+pytestmark = pytest.mark.multiprocess
+
+
+# ---------------------------------------------------------------------------
+# Unit tests (single process)
+# ---------------------------------------------------------------------------
+
+def test_elect_reader_stable_and_spread():
+    worlds = [2, 4, 8]
+    for world in worlds:
+        seen = set()
+        for i in range(64):
+            r = bcast.elect_reader(f"replicated/app/w{i}", None, world)
+            assert 0 <= r < world
+            assert r == bcast.elect_reader(f"replicated/app/w{i}", None, world)
+            seen.add(r)
+        # 64 objects over <=8 ranks: every rank should get some share.
+        assert len(seen) == world
+
+
+def test_eligibility_rules():
+    repl = ArrayEntry("replicated/x", "raw", "float32", [8], replicated=True)
+    per_rank = ArrayEntry("0/x", "raw", "float32", [8], replicated=False)
+    member = ArrayEntry(
+        "batched/slab", "raw_zlib", "float32", [8],
+        replicated=True, raw_range=[0, 32],
+    )
+    assert bcast.eligible(repl, None)
+    assert not bcast.eligible(per_rank, None)
+    assert not bcast.eligible(member, None), "member-framed slabs excluded"
+    assert bcast.eligible(ObjectEntry("replicated/o", replicated=True), None)
+    assert not bcast.eligible(ObjectEntry("0/o", replicated=False), None)
+    huge = ArrayEntry(
+        "replicated/big", "raw", "float32", [10**9], replicated=True
+    )
+    assert not bcast.eligible(huge, None), "BCAST_MAX_BYTES cap"
+    with knobs.override_broadcast_max_bytes(10**10):
+        assert bcast.eligible(huge, None)
+
+
+def test_sharded_entry_eligible_only_for_replicated_targets():
+    inner = ArrayEntry("sharded/x/0", "raw", "float32", [4])
+    entry = ShardedArrayEntry("float32", [8], [Shard([0], [4], inner)])
+    # Host targets (numpy / none): every rank reads the whole array.
+    assert bcast.eligible(entry, None)
+    assert bcast.eligible(entry, np.zeros(8, dtype=np.float32))
+
+
+def test_is_fully_replicated_sharding():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from torchsnapshot_tpu.io_preparers.sharded_array import (
+        is_fully_replicated_sharding,
+    )
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices("cpu")[:1]), ("d",))
+    repl = NamedSharding(mesh, PartitionSpec())
+    assert is_fully_replicated_sharding(repl, (8,))
+
+
+def test_knob_gate():
+    class Local:
+        scales_io_with_local_world = True
+
+    class Remote:
+        scales_io_with_local_world = False
+
+    assert not knobs.is_broadcast_restore_enabled(1, Remote())
+    assert knobs.is_broadcast_restore_enabled(4, Remote())
+    assert not knobs.is_broadcast_restore_enabled(4, Local()), (
+        "auto gate: local-disk plugins default to per-rank reads"
+    )
+    with knobs.override_broadcast_restore(True):
+        assert knobs.is_broadcast_restore_enabled(4, Local())
+        assert not knobs.is_broadcast_restore_enabled(1, Local())
+    with knobs.override_broadcast_restore(False):
+        assert not knobs.is_broadcast_restore_enabled(4, Remote())
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess worker (module-level: must be picklable for spawn)
+# ---------------------------------------------------------------------------
+
+def _worker_broadcast_restore(rank: int, world_size: int, shared: str) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import bcast as bcast_mod
+    from torchsnapshot_tpu.parallel.coordinator import get_coordinator
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = os.path.join(shared, "ckpt")
+    state = StateDict(
+        w1=np.arange(500, dtype=np.float32),
+        w2=np.arange(500, 1000).astype(np.float64),
+        per_rank=np.full(7, rank, dtype=np.int32),
+    )
+    Snapshot.take(path, {"app": state}, replicated=["app/w*"])
+
+    tgt = StateDict(
+        w1=np.zeros(500, dtype=np.float32),
+        w2=np.zeros(500, dtype=np.float64),
+        per_rank=np.zeros(7, dtype=np.int32),
+    )
+    with _knobs.override_broadcast_restore(True):
+        Snapshot(path).restore({"app": tgt})
+    assert np.array_equal(tgt["w1"], state["w1"])
+    assert np.array_equal(tgt["w2"], state["w2"])
+    assert np.array_equal(tgt["per_rank"], np.full(7, rank, dtype=np.int32))
+
+    d = dict(bcast_mod.LAST_RESTORE_BCAST)
+    coord = get_coordinator()
+    gathered = coord.all_gather_object(d)
+    if rank == 0:
+        all_origin = [p for g in gathered for p in g["origin_reads"]]
+        # Exactly one rank read each replicated object from storage.
+        assert sorted(all_origin) == sorted(set(all_origin)), all_origin
+        assert len(set(all_origin)) == 2, gathered
+        # Everyone else received it over the store.
+        recv = sum(len(g["received"]) for g in gathered)
+        assert recv == 2 * (world_size - 1), gathered
+        assert all(g["entries"] == 2 for g in gathered), gathered
+
+    # Broadcast OFF: every rank reads origin itself; diagnostics stay empty.
+    tgt2 = StateDict(
+        w1=np.zeros(500, dtype=np.float32),
+        w2=np.zeros(500, dtype=np.float64),
+        per_rank=np.zeros(7, dtype=np.int32),
+    )
+    with _knobs.override_broadcast_restore(False):
+        Snapshot(path).restore({"app": tgt2})
+    assert np.array_equal(tgt2["w1"], state["w1"])
+    assert bcast_mod.LAST_RESTORE_BCAST["entries"] == 0
+
+
+def test_broadcast_restore_multiprocess(tmp_path):
+    run_with_processes(
+        _worker_broadcast_restore, nproc=2, args=(str(tmp_path),)
+    )

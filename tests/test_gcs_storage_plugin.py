@@ -513,6 +513,46 @@ def test_resumable_upload_recovers_cursor_mid_chunk(fake_gcs, monkeypatch) -> No
     assert stats["sent"] - len(payload) > 0  # faults really did cost re-sends
 
 
+def test_resumable_backoff_clamped_to_progress_window(fake_gcs, monkeypatch) -> None:
+    """The mid-upload retry loop clamps each backoff to the collective-
+    progress window's remaining time and re-checks expiry after sleeping —
+    uniform with retry_transient (PR 5): a final exponential sleep can
+    never overshoot the give-up deadline by a full MAX_BACKOFF period."""
+    import time as _time
+
+    from torchsnapshot_tpu.storage_plugins import cloud_retry
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    # Unclamped, the first backoff would sleep ~30-90s.
+    monkeypatch.setattr(cloud_retry, "BASE_BACKOFF_S", 30.0)
+    monkeypatch.setattr(cloud_retry, "MAX_BACKOFF_S", 90.0)
+    plugin = GCSStoragePlugin(root="bucket")
+    plugin._progress.window_s = 0.2
+
+    class StuckSession:
+        finished = False
+        bytes_uploaded = 0
+
+        def transmit_next_chunk(self):
+            raise ConnectionError("transient mid-upload fault")
+
+        def recover(self):  # pragma: no cover - post-sleep expiry wins
+            raise AssertionError("recover must not run past the deadline")
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        await plugin._drive_resumable(loop, StuckSession(), "big")
+
+    t0 = _time.monotonic()
+    with pytest.raises(ConnectionError):
+        _run(go())
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 5.0, (
+        f"backoff was not clamped to the progress window: slept {elapsed:.1f}s"
+    )
+    _run(plugin.close())
+
+
 def test_small_objects_keep_one_shot_upload(fake_gcs, monkeypatch) -> None:
     from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
     from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin

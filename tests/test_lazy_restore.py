@@ -1,0 +1,191 @@
+"""Lazy partial restore: subtree ``read_object``, ``restore(include=)``,
+and read-side gap coalescing.
+
+The property under test: loading one subtree of a snapshot issues only the
+byte ranges that subtree needs — the rest of the snapshot is never
+requested from storage.
+"""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, telemetry
+from torchsnapshot_tpu.batcher import batch_read_requests
+from torchsnapshot_tpu.io_types import ReadReq
+from torchsnapshot_tpu.snapshot import _matches_include
+from torchsnapshot_tpu.utils import knobs
+
+
+def _take_two_towers(tmp_path):
+    state = StateDict(
+        model={
+            "tower_a": {"w": np.arange(1000, dtype=np.float32)},
+            "tower_b": {"w": np.arange(1000, 2000).astype(np.float32)},
+        },
+        step=11,
+    )
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, {"app": state})
+    return path, state
+
+
+def _read_spans(tm):
+    """(path, nbytes) of every storage.read span in the session."""
+    return [
+        (s.attrs.get("path"), s.attrs.get("nbytes"))
+        for s in tm.spans(name="storage.read")
+    ]
+
+
+def test_read_object_subtree(tmp_path):
+    path, state = _take_two_towers(tmp_path)
+    tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm)
+    try:
+        sub = Snapshot(path).read_object("0/app/model/tower_a")
+    finally:
+        telemetry.deactivate(tm, prev)
+    assert set(sub.keys()) == {"w"}
+    assert np.array_equal(sub["w"], state["model"]["tower_a"]["w"])
+    # Only tower_a's object (plus the metadata doc) was read.
+    paths = [p for p, _ in _read_spans(tm)]
+    assert not any("tower_b" in p for p in paths), paths
+
+
+def test_read_object_subtree_root_key(tmp_path):
+    path, state = _take_two_towers(tmp_path)
+    sub = Snapshot(path).read_object("0/app/model")
+    assert np.array_equal(
+        sub["tower_b"]["w"], state["model"]["tower_b"]["w"]
+    )
+
+
+def test_read_object_leaf_still_works(tmp_path):
+    path, state = _take_two_towers(tmp_path)
+    leaf = Snapshot(path).read_object("0/app/model/tower_a/w")
+    assert np.array_equal(leaf, state["model"]["tower_a"]["w"])
+    assert Snapshot(path).read_object("0/app/step") == 11
+
+
+def test_read_object_missing_path_raises(tmp_path):
+    path, _ = _take_two_towers(tmp_path)
+    with pytest.raises(KeyError):
+        Snapshot(path).read_object("0/app/model/tower_zzz")
+
+
+def test_restore_include_reads_only_subtree(tmp_path):
+    path, state = _take_two_towers(tmp_path)
+    tgt = StateDict(
+        model={
+            "tower_a": {"w": np.zeros(1000, dtype=np.float32)},
+            "tower_b": {"w": np.full(1000, -1.0, np.float32)},
+        },
+        step=0,
+    )
+    tm = telemetry.Telemetry()
+    Snapshot(path).restore(
+        {"app": tgt}, include=["app/model/tower_a"], _telemetry=tm
+    )
+    # Selected subtree restored...
+    assert np.array_equal(tgt["model"]["tower_a"]["w"], state["model"]["tower_a"]["w"])
+    # ...excluded leaves keep their LIVE values (not zeroed, not dropped).
+    assert np.array_equal(tgt["model"]["tower_b"]["w"], np.full(1000, -1.0, np.float32))
+    assert tgt["step"] == 0
+    paths = [p for p, _ in _read_spans(tm)]
+    assert not any("tower_b" in p for p in paths), paths
+
+
+def test_restore_include_glob(tmp_path):
+    path, state = _take_two_towers(tmp_path)
+    tgt = StateDict(
+        model={
+            "tower_a": {"w": np.zeros(1000, dtype=np.float32)},
+            "tower_b": {"w": np.zeros(1000, dtype=np.float32)},
+        },
+        step=0,
+    )
+    Snapshot(path).restore({"app": tgt}, include=["app/model/tower_*/w"])
+    assert np.array_equal(tgt["model"]["tower_a"]["w"], state["model"]["tower_a"]["w"])
+    assert np.array_equal(tgt["model"]["tower_b"]["w"], state["model"]["tower_b"]["w"])
+    assert tgt["step"] == 0, "step filtered out; live value kept"
+
+
+def test_matches_include():
+    assert _matches_include("app/model/t/w", ["app/model"])
+    assert _matches_include("app/model", ["app/model/"])
+    assert _matches_include("app/model/t/w", ["app/*/t/w"])
+    assert not _matches_include("app/other/t", ["app/model"])
+    assert not _matches_include("app/modelx", ["app/model"])
+
+
+# ---------------------------------------------------------------------------
+# Read-side gap coalescing
+# ---------------------------------------------------------------------------
+
+class _SliceConsumer:
+    def __init__(self, out, key):
+        self.out = out
+        self.key = key
+
+    async def consume_buffer(self, buf, executor=None):
+        self.out[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self):
+        return 1
+
+
+def _req(path, begin, end, out):
+    return _SliceReq(path, begin, end, out)
+
+
+def _SliceReq(path, begin, end, out):
+    return ReadReq(
+        path=path,
+        buffer_consumer=_SliceConsumer(out, (path, begin, end)),
+        byte_range=(begin, end),
+    )
+
+
+def test_gap_merge_zero_default_keeps_adjacent_only():
+    out = {}
+    reqs = [_req("o", 0, 10, out), _req("o", 10, 20, out), _req("o", 30, 40, out)]
+    merged = batch_read_requests(reqs)
+    assert len(merged) == 2  # [0,20) merged, [30,40) separate
+
+
+def test_gap_merge_with_tolerance_spans_gaps():
+    import asyncio
+
+    out = {}
+    reqs = [_req("o", 0, 10, out), _req("o", 20, 30, out)]
+    merged = batch_read_requests(reqs, merge_gap_bytes=16)
+    assert len(merged) == 1
+    (m,) = merged
+    assert m.byte_range == (0, 30)
+    # Fan-out delivers each member exactly its own bytes, skipping the gap.
+    data = bytes(range(30))
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(m.buffer_consumer.consume_buffer(memoryview(data)))
+    finally:
+        loop.close()
+    assert out[("o", 0, 10)] == data[0:10]
+    assert out[("o", 20, 30)] == data[20:30]
+
+
+def test_gap_merge_knob():
+    out = {}
+    reqs = [_req("o", 0, 10, out), _req("o", 20, 30, out)]
+    with knobs.override_read_merge_gap_bytes(16):
+        merged = batch_read_requests(reqs)
+    assert len(merged) == 1
+    with knobs.override_read_merge_gap_bytes(4):
+        merged = batch_read_requests(reqs)
+    assert len(merged) == 2
+
+
+def test_gap_merge_never_merges_overlapping():
+    out = {}
+    reqs = [_req("o", 0, 15, out), _req("o", 10, 30, out)]
+    merged = batch_read_requests(reqs, merge_gap_bytes=64)
+    assert len(merged) == 2

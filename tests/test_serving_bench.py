@@ -1,0 +1,66 @@
+"""Serving benchmark harness: fast tier-1 smoke + the slow-lane fleet run.
+
+The fast smoke proves the three serving-path claims end to end at a tiny
+size (cache-on repeat restores read 0 origin bytes; broadcast restore reads
+each replicated object from exactly one rank; a lazy subtree read stays
+within its subtree). The slow-marked run — registered in pre_commit.yaml's
+slow lane — exercises the acceptance-scale fleet (K=8 replicas, 8 broadcast
+ranks)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_bench(
+    mb: int, replicas: int, bcast_ranks: int, timeout: int = 420
+) -> dict:
+    out = subprocess.run(
+        [sys.executable, "benchmarks/serving/main.py"],
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "JAX_PLATFORMS": "cpu",
+            "SERVING_BENCH_MB": str(mb),
+            "SERVING_BENCH_REPLICAS": str(replicas),
+            "SERVING_BENCH_BCAST_RANKS": str(bcast_ranks),
+        },
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _check(det: dict, ranks: int) -> None:
+    cache = det["cache"]
+    assert cache["on"]["warm_origin_bytes_total"] == 0
+    assert cache["off"]["warm_origin_bytes_total"] > 0
+    assert cache["on"]["restore_p50_s"] > 0
+    assert cache["on"]["restore_p99_s"] >= cache["on"]["restore_p50_s"]
+    bc = det["broadcast"]
+    assert bc["on"]["origin_reads_total"] == bc["on"]["origin_reads_unique"] > 0
+    assert bc["on"]["recv_bytes_total"] > 0
+    assert bc["on"]["ranks"] == ranks
+    assert bc["off"]["origin_reads_total"] == 0  # per-rank reads, no bcast
+    lazy = det["lazy_subtree"]
+    assert lazy["origin_bytes"] < det["payload_mb"] * 1e6 / 2
+    assert lazy["subtree_bytes"] > 0
+
+
+def test_serving_bench_smoke_tiny() -> None:
+    rec = _run_bench(mb=4, replicas=3, bcast_ranks=2)
+    assert rec["metric"] == "serving_cold_start_restore_p50"
+    _check(rec["detail"], ranks=2)
+
+
+@pytest.mark.slow
+def test_serving_bench_fleet() -> None:
+    """Acceptance-scale: K=8 simulated replicas cold-starting from one
+    snapshot, broadcast across 8 real ranks."""
+    rec = _run_bench(mb=64, replicas=8, bcast_ranks=8, timeout=600)
+    det = rec["detail"]
+    _check(det, ranks=8)
+    assert det["replicas"] == 8

@@ -650,16 +650,27 @@ class BatchedBufferConsumer(BufferConsumer):
 
 
 def batch_read_requests(
-    read_reqs: List[ReadReq], max_merged_bytes: Optional[int] = None
+    read_reqs: List[ReadReq],
+    max_merged_bytes: Optional[int] = None,
+    merge_gap_bytes: Optional[int] = None,
 ) -> List[ReadReq]:
-    """Merge exactly-adjacent byte-range reads per object into single reads.
+    """Merge adjacent byte-range reads per object into single reads.
 
     ``max_merged_bytes`` caps each merged run so budget-capped sub-reads
     (``buffer_size_limit_bytes``) are never coalesced back into the
     whole-object read they were split to avoid; a single request larger
     than the cap still passes through whole (the usual one-over-budget
     escape hatch).
+
+    ``merge_gap_bytes`` (default: the READ_MERGE_GAP_BYTES knob, 0) also
+    coalesces *near*-adjacent ranges whose gap is at most this many bytes:
+    lazy partial restores of slab-batched subtrees ask for interleaved
+    member ranges, and on high-latency backends fetching (and discarding) a
+    small gap beats an extra round trip. Gap bytes are read but never
+    delivered — each member consumer still sees exactly its own range.
     """
+    if merge_gap_bytes is None:
+        merge_gap_bytes = knobs.get_read_merge_gap_bytes()
     ranged: Dict[str, List[ReadReq]] = {}
     passthrough: List[ReadReq] = []
     for req in read_reqs:
@@ -702,7 +713,8 @@ def batch_read_requests(
 
         for req in reqs:
             if run and (
-                req.byte_range[0] != run[-1].byte_range[1]
+                req.byte_range[0] - run[-1].byte_range[1] > merge_gap_bytes
+                or req.byte_range[0] < run[-1].byte_range[1]
                 or (
                     max_merged_bytes is not None
                     and req.byte_range[1] - run[0].byte_range[0] > max_merged_bytes

@@ -1,0 +1,222 @@
+"""Single-reader + collective-broadcast restore for replicated entries.
+
+A serving fleet restores the SAME replicated parameters on every process;
+left alone, that is ``world_size`` identical reads of every replicated
+object against the origin bucket. With broadcast restore on
+(``TORCHSNAPSHOT_TPU_BCAST_RESTORE``), each replicated object elects one
+reader (stable hash of the object path, so the read load spreads across
+ranks), the elected rank issues the storage read, and the bytes fan out to
+every peer through the coordinator's KV-store broadcast — collapsing N
+origin reads to 1 per object. Consumers and finalizers (``device_put`` onto
+the live target's sharding — the ``get_replicate_sharding`` pattern) then
+run per rank exactly as they would for a locally-read buffer.
+
+Design constraints, and how they are met:
+
+- **No device collectives.** The fan-out rides the same generation-counted
+  store broadcasts the planner uses, so it works on any backend mix (CPU,
+  TPU, mixed pods) and off the main thread never touches XLA.
+- **SPMD symmetry.** Every rank must plan the exact same broadcast sequence
+  or the store collectives deadlock. Eligibility is therefore a pure
+  function of the (identical-everywhere) manifest entry plus env knobs —
+  never of per-rank state like the memory budget — and eligible entries are
+  planned with no budget sub-read limit so their read requests (path, byte
+  range) are identical on every rank. Member-framed compressed slab members
+  are excluded: their byte ranges derive from a ``.ftab`` side object whose
+  fetch can degrade per-rank.
+- **Bounded memory.** Objects above ``TORCHSNAPSHOT_TPU_BCAST_MAX_BYTES``
+  fall back to per-rank reads; the broadcast phase holds at most the
+  elected-rank fetches plus one in-flight broadcast payload.
+
+``LAST_RESTORE_BCAST`` records the most recent restore's broadcast activity
+per process (origin reads issued here vs payloads received) — the
+benchmark/chaos surface asserting "exactly one rank read each replicated
+object from storage".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import telemetry
+from .io_preparers.array import entry_cost_bytes
+from .io_types import ReadIO, ReadReq, StoragePlugin
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    ObjectEntry,
+    ShardedArrayEntry,
+    is_replicated,
+)
+from .utils import knobs
+
+logger = logging.getLogger(__name__)
+
+# Diagnostics of this process's most recent restore (reset by
+# ``Snapshot.restore``): which (path, byte_range) keys THIS rank read from
+# origin storage, which it received via broadcast, and the byte totals.
+LAST_RESTORE_BCAST: Dict[str, Any] = {}
+
+
+def reset_diagnostics() -> None:
+    LAST_RESTORE_BCAST.clear()
+    LAST_RESTORE_BCAST.update(
+        {
+            "origin_reads": [],
+            "received": [],
+            "origin_bytes": 0,
+            "recv_bytes": 0,
+            "entries": 0,
+        }
+    )
+
+
+def is_fully_replicated_target(live: Any) -> bool:
+    """Whether ``live`` implies every process restores the WHOLE array —
+    the condition under which a sharded saved entry's read set is identical
+    across ranks (and broadcast therefore wins). True for host targets
+    (numpy / none: restore materializes the full array everywhere) and for
+    jax targets with a fully-replicated sharding."""
+    from .io_preparers.sharded_array import is_fully_replicated_sharding
+
+    try:
+        import jax
+
+        if isinstance(live, jax.Array):
+            return is_fully_replicated_sharding(
+                live.sharding, tuple(int(s) for s in live.shape)
+            )
+    except ImportError:  # pragma: no cover - jax always present here
+        pass
+    return True
+
+
+def eligible(entry: Entry, live: Any) -> bool:
+    """SPMD-pure broadcast eligibility: derived from the manifest entry,
+    env knobs, and the (globally consistent) target kind only."""
+    max_bytes = knobs.get_broadcast_max_bytes()
+    if isinstance(entry, ArrayEntry):
+        if not is_replicated(entry) or entry.raw_range is not None:
+            return False
+        return entry_cost_bytes(entry) <= max_bytes
+    if isinstance(entry, ChunkedArrayEntry):
+        if not is_replicated(entry):
+            return False
+        if any(c.tensor.raw_range is not None for c in entry.chunks):
+            return False
+        return sum(entry_cost_bytes(c.tensor) for c in entry.chunks) <= max_bytes
+    if isinstance(entry, ObjectEntry):
+        # Pickled objects don't record a size in the manifest; replicated
+        # objects are configs/schedules in practice, far below the cap.
+        return is_replicated(entry)
+    if isinstance(entry, ShardedArrayEntry):
+        # A sharded SAVE restored onto a fully-replicated target (the
+        # serving shape: train sharded, serve replicated) reads every shard
+        # on every rank — the same N× redundancy as replicated entries.
+        if any(s.tensor.raw_range is not None for s in entry.shards):
+            return False
+        if sum(entry_cost_bytes(s.tensor) for s in entry.shards) > max_bytes:
+            return False
+        return is_fully_replicated_target(live)
+    return False
+
+
+def elect_reader(path: str, byte_range: Optional[Tuple[int, int]], world: int) -> int:
+    """Stable reader election, spreading replicated objects across ranks.
+    sha1 (not ``hash``): identical across processes regardless of hash
+    randomization."""
+    key = f"{path}|{byte_range}"
+    return int.from_bytes(
+        hashlib.sha1(key.encode()).digest()[:4], "big"
+    ) % max(1, world)
+
+
+class BroadcastItem:
+    """One eligible entry's planned reads + finalizer."""
+
+    __slots__ = ("logical_path", "reqs", "finalize")
+
+    def __init__(
+        self,
+        logical_path: str,
+        reqs: List[ReadReq],
+        finalize: Optional[Callable[[], None]],
+    ) -> None:
+        self.logical_path = logical_path
+        self.reqs = reqs
+        self.finalize = finalize
+
+
+def run_broadcast(
+    items: List[BroadcastItem],
+    storage: StoragePlugin,
+    coord,
+    event_loop: asyncio.AbstractEventLoop,
+    executor=None,
+) -> None:
+    """Execute the broadcast phase for one stateful's eligible entries.
+
+    Called at the same program point on every rank with an identical
+    ``items`` sequence (SPMD). The elected reads run concurrently through
+    the origin plugin first; the broadcasts then proceed in deterministic
+    order, each immediately consumed (deserialize + scatter into the
+    target) and finalized."""
+    if not items:
+        return
+    rank = coord.get_rank()
+    world = coord.get_world_size()
+    if not LAST_RESTORE_BCAST:
+        reset_diagnostics()
+
+    keys: List[Tuple[str, Optional[Tuple[int, int]]]] = []
+    for item in items:
+        for req in item.reqs:
+            keys.append((req.path, req.byte_range))
+    assigned = [k for k in keys if elect_reader(k[0], k[1], world) == rank]
+
+    fetched: Dict[Tuple[str, Optional[Tuple[int, int]]], bytes] = {}
+
+    async def fetch_assigned() -> None:
+        sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
+
+        async def fetch_one(key) -> None:
+            if key in fetched:
+                return
+            async with sem:
+                read_io = ReadIO(path=key[0], byte_range=key[1])
+                await storage.read(read_io)
+                fetched[key] = read_io.buf.getvalue()
+
+        await asyncio.gather(*(fetch_one(k) for k in dict.fromkeys(assigned)))
+
+    event_loop.run_until_complete(fetch_assigned())
+    origin_bytes = sum(len(v) for v in fetched.values())
+    if fetched:
+        telemetry.counter_add("bcast.origin_reads", len(fetched))
+        telemetry.counter_add("bcast.origin_bytes", origin_bytes)
+        LAST_RESTORE_BCAST["origin_reads"].extend(
+            sorted(k[0] for k in fetched)
+        )
+        LAST_RESTORE_BCAST["origin_bytes"] += origin_bytes
+
+    telemetry.counter_add("bcast.entries", len(items))
+    LAST_RESTORE_BCAST["entries"] += len(items)
+    for item in items:
+        for req in item.reqs:
+            key = (req.path, req.byte_range)
+            src = elect_reader(key[0], key[1], world)
+            payload = fetched.get(key) if rank == src else None
+            data = coord.broadcast_object(payload, src=src)
+            if rank != src:
+                telemetry.counter_add("bcast.recv_bytes", len(data))
+                LAST_RESTORE_BCAST["received"].append(key[0])
+                LAST_RESTORE_BCAST["recv_bytes"] += len(data)
+            event_loop.run_until_complete(
+                req.buffer_consumer.consume_buffer(memoryview(data), executor)
+            )
+        if item.finalize is not None:
+            item.finalize()

@@ -443,6 +443,33 @@ def alloc_target_shards(sharding, global_shape, np_dtype) -> Dict[Tuple[int, ...
     return out
 
 
+def is_fully_replicated_sharding(sharding, global_shape) -> bool:
+    """True when every device of ``sharding`` holds the WHOLE array — the
+    ``get_replicate_sharding()`` pattern serving meshes use. Such targets
+    make a sharded entry's restore read set identical on every process
+    (each reads all shards into one full-extent buffer), which is what lets
+    broadcast restore fan one rank's reads out to the fleet. Prefers the
+    sharding's own ``is_fully_replicated`` (GSPMD-global: consistent across
+    processes); falls back to checking that every *addressable* index spans
+    the full extent."""
+    flag = getattr(sharding, "is_fully_replicated", None)
+    if flag is not None:
+        return bool(flag)
+    try:
+        index_map = sharding.addressable_devices_indices_map(
+            tuple(int(s) for s in global_shape)
+        )
+        for index in index_map.values():
+            offsets, sizes = index_to_offsets_sizes(index, global_shape)
+            if any(o != 0 for o in offsets) or list(sizes) != [
+                int(s) for s in global_shape
+            ]:
+                return False
+        return True
+    except Exception:  # pragma: no cover - exotic sharding types
+        return False
+
+
 def assemble_jax_array(sharding, global_shape, buffers: Dict[Tuple[int, ...], Tuple[np.ndarray, List[int], List[int]]]):
     """Build a jax.Array with ``sharding`` from filled host buffers."""
     import jax

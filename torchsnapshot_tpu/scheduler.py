@@ -1331,7 +1331,11 @@ async def execute_read_reqs(
     memory_budget_bytes: int,
     rank: int,
     pools: Optional[PipelinePools] = None,
-) -> None:
+) -> Dict[str, float]:
+    """Drive the read pipeline to completion. Returns this pipeline's
+    accounting — ``{"bytes_read", "wall_s", "requests"}`` — so restore
+    callers can aggregate a restore-side record (bench regression gate,
+    persisted artifacts) without a telemetry session."""
     begin_ts = time.monotonic()
     budget = _Budget(memory_budget_bytes, owner=f"read@rank{rank}")
     pending: Deque[ReadReq] = deque(
@@ -1453,6 +1457,11 @@ async def execute_read_reqs(
             elapsed,
             bytes_read / 1e9 / max(elapsed, 1e-9),
         )
+    return {
+        "bytes_read": float(bytes_read),
+        "wall_s": elapsed,
+        "requests": float(len(read_reqs)),
+    }
 
 
 def sync_execute_read_reqs(
@@ -1462,8 +1471,8 @@ def sync_execute_read_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
     pools: Optional[PipelinePools] = None,
-) -> None:
-    event_loop.run_until_complete(
+) -> Dict[str, float]:
+    return event_loop.run_until_complete(
         execute_read_reqs(
             read_reqs, storage, memory_budget_bytes, rank, pools=pools
         )

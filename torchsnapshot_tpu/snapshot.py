@@ -36,6 +36,7 @@ import fnmatch
 import logging
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -101,6 +102,15 @@ LAST_TAKE_PHASES: Dict[str, float] = {}
 # storage writes) rather than re-derived from wall clock. Diagnostics only:
 # overwritten per take, per process.
 LAST_SYNC_DRAIN_STATS: Dict[str, float] = {}
+
+# Restore-side accounting of this process's most recent ``restore()``:
+# end-to-end wall seconds, aggregated read-pipeline stats (bytes_read /
+# read_wall_s / requests), and the broadcast-restore record
+# (``bcast.LAST_RESTORE_BCAST``). The restore analogue of the take
+# diagnostics above — bench.py's restore regression gate and the serving
+# benchmark read it without needing a telemetry session. Diagnostics only:
+# overwritten per restore, per process.
+LAST_RESTORE_STATS: Dict[str, Any] = {}
 
 
 def _begin_telemetry(
@@ -861,23 +871,50 @@ class Snapshot:
         self,
         app_state: AppState,
         _telemetry: Optional["telemetry.Telemetry"] = None,
+        include: Optional[List[str]] = None,
     ) -> None:
+        """``include``: optional list of logical-path globs (e.g.
+        ``["model/encoder/*"]``) restricting the restore to the matching
+        manifest subtrees — a lazy partial restore reads ONLY the byte
+        ranges those entries need, leaving the rest of the snapshot
+        untouched (loading one tower of a model doesn't fetch the others).
+        A pattern selects an entry when it fnmatch-es its logical path or
+        names one of its ancestors. Statefuls receive a partially-populated
+        state dict for the filtered-out leaves; their ``load_state_dict``
+        must tolerate that (flax/optax dicts do). SPMD: every rank must
+        pass the same ``include``."""
         self._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
         rank = coord.get_rank()
         tm, tm_prev = _begin_telemetry(_telemetry)
+        restore_t0 = time.monotonic()
+        from . import bcast as bcast_mod
+
+        bcast_mod.reset_diagnostics()
+        LAST_RESTORE_STATS.clear()
+        read_totals = {"bytes_read": 0.0, "read_wall_s": 0.0, "requests": 0.0}
         # Before any storage IO: the metadata read below would otherwise
         # freeze the FS plugin's O_DIRECT stream cap at the unscaled default
         # in a fresh (restore-only) process.
         memory_budget = get_process_memory_budget_bytes(coord)
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
+        # Broadcast restore: resolved once per restore (pure function of
+        # world size + knob + the storage plugin's locality flag) so every
+        # stateful of this restore — and every rank — agrees on the gate.
+        bcast_enabled = knobs.is_broadcast_restore_enabled(
+            coord.get_world_size(), storage
+        )
         # One pool set for every per-stateful read pipeline of this restore
         # (instead of a fresh ThreadPoolExecutor per stateful).
         pools = PipelinePools()
         try:
             with telemetry.span("restore.read_metadata", cat="restore"):
                 metadata = self._read_metadata(storage, event_loop)
+            # Content-addressed read-through cache: hand it the snapshot's
+            # dedup digests so data-object reads become digest-keyed
+            # (shared across snapshots, verifiable on hit).
+            self._attach_cache_digests(storage, metadata, event_loop)
             manifest = get_manifest_for_rank(metadata, rank)
             # One-pass prefix index: bucket entries by their FIRST path
             # segment so per-key planning below is O(bucket), not
@@ -912,7 +949,7 @@ class Snapshot:
                     with telemetry.span(
                         "restore.load_stateful", cat="restore", key=key
                     ):
-                        self._load_stateful(
+                        stats = self._load_stateful(
                             key=key,
                             stateful=app_state[key],
                             manifest=by_first_seg.get(key.partition("/")[0], {}),
@@ -920,7 +957,20 @@ class Snapshot:
                             memory_budget=memory_budget,
                             event_loop=event_loop,
                             pools=pools,
+                            include=include,
+                            bcast_enabled=bcast_enabled,
+                            coord=coord,
                         )
+                        if stats:
+                            read_totals["bytes_read"] += stats.get(
+                                "bytes_read", 0.0
+                            )
+                            read_totals["read_wall_s"] += stats.get(
+                                "wall_s", 0.0
+                            )
+                            read_totals["requests"] += stats.get(
+                                "requests", 0.0
+                            )
             # Restore telemetry artifact (.telemetry/restore_rank_<k>.json):
             # the restore-side record — metrics dump (bytes read per
             # plugin), per-stateful load spans — written through the same
@@ -938,6 +988,9 @@ class Snapshot:
             # complete (and e.g. deletes/overwrites the snapshot, or
             # reports readiness) while a peer is still reading storage.
             coord.barrier()
+            LAST_RESTORE_STATS.update(read_totals)
+            LAST_RESTORE_STATS["wall_s"] = time.monotonic() - restore_t0
+            LAST_RESTORE_STATS["bcast"] = dict(bcast_mod.LAST_RESTORE_BCAST)
         finally:
             pools.shutdown()
             storage.sync_close(event_loop)
@@ -953,7 +1006,10 @@ class Snapshot:
         memory_budget: int,
         event_loop: asyncio.AbstractEventLoop,
         pools: Optional[PipelinePools] = None,
-    ) -> None:
+        include: Optional[List[str]] = None,
+        bcast_enabled: bool = False,
+        coord: Optional[Coordinator] = None,
+    ) -> Dict[str, float]:
         # Per-read cap = the whole process budget: a single object/shard
         # larger than the budget would otherwise be admitted whole through
         # the scheduler's one-over-budget escape hatch — the RSS spike the
@@ -969,7 +1025,24 @@ class Snapshot:
             for p, e in manifest.items()
             if (p == key or p.startswith(prefix)) and not is_container_entry(e)
         }
+        excluded_paths: List[str] = []
+        if include:
+            # Lazy partial restore: only the requested subtrees are planned,
+            # so only their byte ranges are ever fetched. Excluded leaves
+            # keep their LIVE values (seeded into ``loaded`` below), so the
+            # state dict handed to ``load_state_dict`` stays full-shaped
+            # and the un-restored parts of the stateful are untouched.
+            selected = {
+                p: e
+                for p, e in entries.items()
+                if _matches_include(p, include)
+            }
+            excluded_paths = [p for p in entries if p not in selected]
+            entries = selected
         loaded: Dict[str, Any] = {}
+        for p in excluded_paths:
+            if p in live_flattened:
+                loaded[p] = live_flattened[p]
         read_reqs: List[ReadReq] = []
         # Overlapped restore (knob-gated, see is_restore_overlap_enabled):
         # each entry's finalizer (its host → device transfer) runs ON THE
@@ -1023,11 +1096,37 @@ class Snapshot:
             event_loop,
             _memory_budget_bytes_per_read,
         )
+        from . import bcast as bcast_mod
+
+        bcast_items: List["bcast_mod.BroadcastItem"] = []
         for idx, (logical_path, entry) in enumerate(entries.items()):
+            live = live_flattened.get(logical_path)
+            if (
+                bcast_enabled
+                and coord is not None
+                and bcast_mod.eligible(entry, live)
+            ):
+                # Single-reader + broadcast path. Planned with NO budget
+                # sub-read limit so the (path, byte_range) sequence is a
+                # pure function of the entry — identical on every rank,
+                # which the store broadcasts below require. Bounded by the
+                # BCAST_MAX_BYTES eligibility cap.
+                reqs, finalize = _prepare_restore_one(
+                    logical_path,
+                    entry,
+                    live,
+                    loaded,
+                    buffer_size_limit_bytes=None,
+                    frame_tables=frame_tables,
+                )
+                bcast_items.append(
+                    bcast_mod.BroadcastItem(logical_path, reqs, finalize)
+                )
+                continue
             reqs, finalize = _prepare_restore_one(
                 logical_path,
                 entry,
-                live_flattened.get(logical_path),
+                live,
                 loaded,
                 buffer_size_limit_bytes=_memory_budget_bytes_per_read,
                 frame_tables=frame_tables,
@@ -1054,6 +1153,19 @@ class Snapshot:
                     deferred_finalizers.append(finalize)
             read_reqs.extend(reqs)
 
+        if bcast_items:
+            # Broadcast phase first (replicated entries land before the
+            # bulk pipeline): one elected rank per object reads storage,
+            # the bytes fan out over the coordinator store, every rank
+            # consumes + finalizes locally.
+            bcast_mod.run_broadcast(
+                bcast_items,
+                storage,
+                coord,
+                event_loop,
+                executor=pools.consuming_executor() if pools else None,
+            )
+
         if knobs.is_batching_enabled():
             from .batcher import batch_read_requests
 
@@ -1061,7 +1173,7 @@ class Snapshot:
                 read_reqs, max_merged_bytes=_memory_budget_bytes_per_read
             )
 
-        sync_execute_read_reqs(
+        read_stats = sync_execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,
             memory_budget_bytes=memory_budget,
@@ -1087,6 +1199,7 @@ class Snapshot:
             full_manifest: Manifest = dict(container_manifest)
             state_dict = inflate(full_manifest, loaded, prefix=key)
         stateful.load_state_dict(state_dict)
+        return read_stats or {}
 
     # ----------------------------------------------------------- read_object
     def read_object(
@@ -1095,8 +1208,16 @@ class Snapshot:
         obj_out: Optional[Any] = None,
         memory_budget_bytes: Optional[int] = None,
     ) -> Any:
-        """Random access to one persisted object, addressed as
-        ``"<rank>/<logical_path>"`` (reference ``snapshot.py:507-612``).
+        """Random access to one persisted object — or a manifest SUBTREE —
+        addressed as ``"<rank>/<logical_path>"`` (reference
+        ``snapshot.py:507-612``).
+
+        A leaf path returns that value. A container path (or any prefix of
+        logical paths) performs a **lazy partial read**: only the entries
+        under the subtree are planned, their byte ranges coalesced through
+        the read batcher, and the nested structure is rebuilt and returned
+        — loading one tower of a model never touches the rest of the
+        snapshot. ``obj_out`` applies to leaf reads only.
 
         Works against cloud storage via ranged reads without fetching the
         whole snapshot; ``memory_budget_bytes`` caps host RSS for huge
@@ -1109,14 +1230,19 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
         try:
             metadata = self._read_metadata(storage, event_loop)
+            self._attach_cache_digests(storage, metadata, event_loop)
             rank_str, _, logical_path = path.partition("/")
             manifest = get_manifest_for_rank(metadata, int(rank_str))
-            if logical_path not in manifest:
-                raise KeyError(
-                    f"{path!r} not found in snapshot (available under rank "
-                    f"{rank_str}: {sorted(manifest.keys())[:20]}...)"
+            entry = manifest.get(logical_path)
+            if entry is None or is_container_entry(entry):
+                return self._read_subtree(
+                    path,
+                    logical_path,
+                    manifest,
+                    storage,
+                    event_loop,
+                    memory_budget_bytes,
                 )
-            entry = manifest[logical_path]
             if isinstance(entry, PrimitiveEntry):
                 return entry.get_value()
             loaded: Dict[str, Any] = {}
@@ -1130,6 +1256,11 @@ class Snapshot:
                 loaded,
                 buffer_size_limit_bytes=memory_budget_bytes,
                 frame_tables=frame_tables,
+            )
+            from .batcher import batch_read_requests
+
+            reqs = batch_read_requests(
+                reqs, max_merged_bytes=memory_budget_bytes
             )
             sync_execute_read_reqs(
                 read_reqs=reqs,
@@ -1147,6 +1278,115 @@ class Snapshot:
         finally:
             storage.sync_close(event_loop)
             event_loop.close()
+
+    def _read_subtree(
+        self,
+        path: str,
+        logical_path: str,
+        manifest: Manifest,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        memory_budget_bytes: Optional[int],
+    ) -> Any:
+        """Lazy partial read of one manifest subtree: plan only the entries
+        under ``logical_path``, coalesce their byte ranges through the read
+        batcher (near-adjacent slab-member ranges merge per the
+        READ_MERGE_GAP_BYTES knob), execute, and inflate the nested
+        structure. The rest of the snapshot's bytes are never requested."""
+        sub_prefix = f"{logical_path}/"
+        leaves = {
+            p: e
+            for p, e in manifest.items()
+            if (p == logical_path or p.startswith(sub_prefix))
+            and not is_container_entry(e)
+        }
+        if not leaves:
+            raise KeyError(
+                f"{path!r} not found in snapshot (no entries under "
+                f"{logical_path!r})"
+            )
+        loaded: Dict[str, Any] = {}
+        read_reqs: List[ReadReq] = []
+        finalizers: List[Callable[[], None]] = []
+        frame_tables = _fetch_frame_tables(
+            [(e, None) for e in leaves.values()],
+            storage,
+            event_loop,
+            memory_budget_bytes,
+        )
+        for p, entry in leaves.items():
+            reqs, finalize = _prepare_restore_one(
+                p,
+                entry,
+                None,
+                loaded,
+                buffer_size_limit_bytes=memory_budget_bytes,
+                frame_tables=frame_tables,
+            )
+            read_reqs.extend(reqs)
+            if finalize is not None:
+                finalizers.append(finalize)
+        from .batcher import batch_read_requests
+
+        read_reqs = batch_read_requests(
+            read_reqs, max_merged_bytes=memory_budget_bytes
+        )
+        sync_execute_read_reqs(
+            read_reqs=read_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes
+            or get_process_memory_budget_bytes(None),
+            rank=0,
+            event_loop=event_loop,
+        )
+        for finalize in finalizers:
+            finalize()
+        containers = {
+            p: e
+            for p, e in manifest.items()
+            if (p == logical_path or p.startswith(sub_prefix))
+            and is_container_entry(e)
+        }
+        return inflate(containers, loaded, prefix=logical_path)
+
+    def _attach_cache_digests(
+        self,
+        storage: StoragePlugin,
+        metadata: SnapshotMetadata,
+        event_loop: asyncio.AbstractEventLoop,
+    ) -> None:
+        """When a read-through cache wraps this plugin stack, hand it the
+        snapshot's ``{path: (size, sha256)}`` dedup digests (from the
+        checksum sidecars) so data-object reads become content-addressed.
+        Fail-open: a sidecar hiccup just leaves those reads path-keyed."""
+        if not knobs.get_read_cache_dir():
+            return
+        from .storage_plugins.cache import find_read_cache
+
+        cache = find_read_cache(storage)
+        if cache is None:
+            return
+        try:
+            merged, _, _ = _read_checksum_sidecars(
+                storage, metadata.world_size, event_loop
+            )
+        except Exception:  # noqa: BLE001 - cache stays path-keyed
+            logger.warning(
+                "could not read checksum sidecars for the read cache; "
+                "reads stay path-keyed",
+                exc_info=True,
+            )
+            return
+        # [crc32, size, sha256 | None] per object: a sha makes the cache
+        # entry content-addressed; a sha-less record (dedup digests off at
+        # take time) still enables size+crc validation of path-keyed hits.
+        index = {
+            p: (v[1], v[2], v[0])
+            for p, v in merged.items()
+            if isinstance(v, list) and len(v) == 3
+        }
+        if index:
+            cache.attach_digest_index(index)
 
     def verify(self) -> Dict[str, str]:
         """Audit the snapshot's storage objects against the CRC32 sidecars
@@ -1663,6 +1903,19 @@ def _is_jax_array(obj: Any) -> bool:
     import jax
 
     return isinstance(obj, jax.Array)
+
+
+def _matches_include(path: str, globs: List[str]) -> bool:
+    """Whether a logical path is selected by a lazy-restore include list.
+
+    A pattern selects a path when it fnmatch-es the full path, equals it,
+    or names one of its ancestors (``"model/encoder"`` selects everything
+    under that subtree without needing a trailing ``/*``)."""
+    for g in globs:
+        g = g.rstrip("/")
+        if path == g or path.startswith(f"{g}/") or fnmatch.fnmatch(path, g):
+            return True
+    return False
 
 
 def _wanted_framed_locations(

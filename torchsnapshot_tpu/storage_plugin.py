@@ -70,6 +70,14 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
     plugin = _resolve_storage_plugin(url_path)
     from .utils import knobs
 
+    if knobs.get_read_cache_dir():
+        # Content-addressed read-through cache (serving fleets: K replicas
+        # cold-start from one snapshot, the origin is read once). Wrapped
+        # BELOW the fault injector so chaos schedules exercise the cache
+        # surface too. See storage_plugins/cache.py.
+        from .storage_plugins.cache import maybe_wrap_with_read_cache
+
+        plugin = maybe_wrap_with_read_cache(plugin, origin_id=url_path)
     if knobs.get_faults_spec():
         # Deterministic fault injection (tests only): wrap EVERY plugin this
         # process — and, since the env var is inherited, every child rank —

@@ -1,0 +1,424 @@
+"""Content-addressed read-through cache, layered over any storage plugin.
+
+The serving-scale read problem: a fleet of K inference replicas cold-starts
+from ONE committed snapshot, and every replica independently hammers the
+origin bucket for the same bytes. :class:`CachedStoragePlugin` wraps the
+origin plugin (fs/gcs/s3/memory alike) with a byte-bounded local store so
+repeat reads — a replica restarting, several co-hosted replicas, successive
+snapshots sharing frozen layers — are served from local disk instead of the
+origin.
+
+Two entry tiers:
+
+- **Digest-keyed** (``by-digest/<aa>/<sha256>``): objects covered by the
+  snapshot's checksum sidecars (the dedup digests PR 1 pinned —
+  ``[crc32, size, sha256]`` per storage object). Content-addressed, so the
+  same bytes are cached ONCE across snapshots (incremental takes hard-link
+  unchanged objects: every snapshot in a delta chain hits the same cache
+  entry) and a hit can be *verified* against its recorded sha256 before it
+  is served (``TORCHSNAPSHOT_TPU_READ_CACHE_VERIFY``, default on) — a
+  corrupt local entry falls back to the origin and is re-populated. The
+  digest index is attached by ``Snapshot.restore``/``read_object`` after
+  reading the sidecars (:meth:`CachedStoragePlugin.attach_digest_index`).
+- **Path-keyed** (``by-path/<sha256(origin || path)>``): everything else —
+  ``.snapshot_metadata``, the sidecars themselves, ``.ftab`` frame tables.
+  Keyed by (origin URL, path), so distinct origins never collide. Writes or
+  deletes issued *through this process's plugin* invalidate the path entry;
+  an out-of-band retake into the same committed path from another host is
+  the documented staleness caveat (serve immutable, uniquely-named snapshot
+  roots — the ``/checkpoints/step_N`` layout — and this never triggers).
+
+Guarantees:
+
+- **Populate is atomic** (write to ``tmp/``, then ``os.replace``): a
+  concurrent reader observes a fully-populated entry or none — never torn
+  bytes. Two processes populating the same digest both land identical
+  content; within one process, concurrent readers of one key share a single
+  origin fetch (in-flight dedup).
+- **Byte-bounded**: after each populate the store is scanned (the local
+  analogue of ``list_prefix``) and least-recently-used entries — hits bump
+  an entry's mtime — are evicted until the store fits
+  ``TORCHSNAPSHOT_TPU_READ_CACHE_BYTES``.
+- **Ranged reads never over-fetch**: a byte-range miss passes through to
+  the origin untouched (lazy partial restores must read only the ranges
+  they need); ranges are served locally only when the full object is
+  already cached.
+- **Fail-open**: any cache-store failure (disk full, permissions) degrades
+  to a plain origin read — the cache can slow a restore down, never fail it.
+
+Telemetry: ``cache.hits``/``cache.misses`` (+ ``_bytes``),
+``cache.bypass_reads`` (ranged pass-throughs), ``cache.evictions``/
+``cache.evicted_bytes``, ``cache.corrupt_entries``; populates are traced as
+``storage.cache_populate`` spans.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import logging
+import os
+import threading
+import uuid
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
+from ..utils import knobs
+
+logger = logging.getLogger(__name__)
+
+# Sidecar paths churn per take (and are tiny); caching them path-keyed is
+# still correct because a write through this plugin invalidates the entry.
+_TMP_DIR = "tmp"
+_DIGEST_DIR = "by-digest"
+_PATH_DIR = "by-path"
+
+
+def find_read_cache(storage) -> Optional["CachedStoragePlugin"]:
+    """Locate the cache layer inside a (possibly wrapped) plugin stack —
+    e.g. ``FaultyStoragePlugin(CachedStoragePlugin(origin))`` under chaos
+    testing. Walks ``inner`` links; None when no cache layer is present."""
+    seen = 0
+    while storage is not None and seen < 8:
+        if isinstance(storage, CachedStoragePlugin):
+            return storage
+        storage = getattr(storage, "inner", None)
+        seen += 1
+    return None
+
+
+class CachedStoragePlugin(StoragePlugin):
+    """Read-through cache over ``inner``; all writes delegate (write-through
+    with path-entry invalidation). See the module docstring for semantics."""
+
+    def __init__(
+        self,
+        inner: StoragePlugin,
+        origin_id: str,
+        cache_dir: Optional[str] = None,
+        max_bytes: Optional[int] = None,
+    ) -> None:
+        self.inner = inner
+        self.origin_id = origin_id
+        self.cache_dir = cache_dir or knobs.get_read_cache_dir() or ""
+        if not self.cache_dir:
+            raise ValueError(
+                "CachedStoragePlugin needs a cache directory (argument or "
+                "TORCHSNAPSHOT_TPU_READ_CACHE_DIR)"
+            )
+        self._max_bytes = (
+            max_bytes if max_bytes is not None else knobs.get_read_cache_bytes()
+        )
+        # path -> (size, sha256-hex | None, crc32 | None): the sidecar
+        # digests of the snapshot(s) being read, attached by
+        # Snapshot.restore/read_object. A sha makes the entry
+        # content-addressed; without one (DEDUP_DIGESTS off at take time)
+        # the entry stays path-keyed but hits are still size+crc-validated.
+        # Paths absent here fall back to unvalidated path-keyed entries.
+        self._digests: Dict[str, Tuple[int, Optional[str], Optional[int]]] = {}
+        self._executor: Optional[ThreadPoolExecutor] = None
+        # Guards the store-size accounting and LRU bookkeeping, which are
+        # mutated from executor threads.
+        self._lock = threading.Lock()
+        self._total_bytes: Optional[int] = None  # lazy first-scan
+        # In-flight populate dedup: concurrent readers of one cache key on
+        # one event loop share a single origin fetch.
+        self._inflight: Dict[str, asyncio.Future] = {}
+
+    # -- capability flags proxy the origin ----------------------------------
+    @property
+    def supports_streaming(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self.inner, "supports_streaming", False))
+
+    @property
+    def scales_io_with_local_world(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self.inner, "scales_io_with_local_world", False))
+
+    # -- digest index --------------------------------------------------------
+    def attach_digest_index(
+        self, index: Dict[str, Tuple[int, Optional[str], Optional[int]]]
+    ) -> None:
+        """Merge ``{path: (size, sha256 | None, crc32 | None)}`` — the
+        parsed checksum sidecars — so reads of those paths become
+        content-addressed (sha present) or at least size+crc-validated.
+        Idempotent; callers may attach once per snapshot they read through
+        this plugin."""
+        with self._lock:
+            self._digests.update(index)
+
+    # -- local store helpers (blocking; run on the executor) -----------------
+    def _get_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tss-cache"
+            )
+        return self._executor
+
+    def _digest_entry_path(self, sha: str) -> str:
+        return os.path.join(self.cache_dir, _DIGEST_DIR, sha[:2], sha)
+
+    def _path_entry_path(self, path: str) -> str:
+        key = hashlib.sha256(
+            f"{self.origin_id}\0{path}".encode()
+        ).hexdigest()
+        return os.path.join(self.cache_dir, _PATH_DIR, key[:2], key)
+
+    def _entry_for(
+        self, path: str
+    ) -> Tuple[str, Optional[Tuple[int, Optional[str], Optional[int]]]]:
+        digest = self._digests.get(path)
+        if digest is not None and digest[1]:
+            return self._digest_entry_path(digest[1]), digest
+        return self._path_entry_path(path), digest
+
+    def _read_entry(
+        self,
+        entry: str,
+        expect: Optional[Tuple[int, Optional[str], Optional[int]]],
+        verify: bool,
+    ) -> Optional[bytes]:
+        """Read one cache entry, validating it against the sidecar digest
+        when one is known (size always; sha256 — or crc32 for sha-less
+        sidecars — under the verify knob). Returns None on miss or
+        corruption (the corrupt entry is unlinked)."""
+        try:
+            with open(entry, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError:
+            logger.warning("cache entry %s unreadable", entry, exc_info=True)
+            return None
+        if expect is not None:
+            size, sha, crc = expect
+            ok = len(data) == size
+            if ok and verify:
+                if sha:
+                    ok = hashlib.sha256(data).hexdigest() == sha
+                elif crc is not None:
+                    ok = zlib.crc32(data) == crc
+            if not ok:
+                telemetry.counter_add("cache.corrupt_entries")
+                logger.warning(
+                    "corrupt cache entry %s (expected %d bytes, digest %s); "
+                    "falling back to origin and re-populating",
+                    entry,
+                    size,
+                    (sha or crc),
+                )
+                with contextlib.suppress(OSError):
+                    os.remove(entry)
+                return None
+        # LRU touch: hits keep an entry young. Never fatal.
+        with contextlib.suppress(OSError):
+            os.utime(entry)
+        return data
+
+    def _write_entry(self, entry: str, data: bytes) -> None:
+        """Atomic populate-then-rename; a concurrent reader sees the full
+        entry or none. Failures propagate to the fail-open caller."""
+        tmp_dir = os.path.join(self.cache_dir, _TMP_DIR)
+        os.makedirs(tmp_dir, exist_ok=True)
+        os.makedirs(os.path.dirname(entry), exist_ok=True)
+        tmp = os.path.join(tmp_dir, f"{uuid.uuid4().hex}.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, entry)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+        with self._lock:
+            if self._total_bytes is not None:
+                self._total_bytes += len(data)
+        self._maybe_evict()
+
+    def _scan(self) -> List[Tuple[str, int, float]]:
+        """All cache entries as (abs path, size, mtime) — the local-store
+        analogue of ``list_prefix``, and the substrate of eviction."""
+        out: List[Tuple[str, int, float]] = []
+        for sub in (_DIGEST_DIR, _PATH_DIR):
+            base = os.path.join(self.cache_dir, sub)
+            for dirpath, _, filenames in os.walk(base):
+                for name in filenames:
+                    p = os.path.join(dirpath, name)
+                    try:
+                        st = os.stat(p)
+                    except OSError:
+                        continue  # evicted/replaced underfoot
+                    out.append((p, st.st_size, st.st_mtime))
+        return out
+
+    def _maybe_evict(self) -> None:
+        """Evict least-recently-used entries until the store fits the byte
+        budget. Runs after each populate, on the executor thread that
+        populated; the scan re-derives ground truth so concurrent
+        populators never double-count."""
+        with self._lock:
+            total = self._total_bytes
+        if total is None or total > self._max_bytes:
+            entries = self._scan()
+            total = sum(sz for _, sz, _ in entries)
+            evicted = 0
+            evicted_bytes = 0
+            if total > self._max_bytes:
+                for p, sz, _ in sorted(entries, key=lambda e: e[2]):
+                    if total <= self._max_bytes:
+                        break
+                    with contextlib.suppress(OSError):
+                        os.remove(p)
+                        total -= sz
+                        evicted += 1
+                        evicted_bytes += sz
+            if evicted:
+                telemetry.counter_add("cache.evictions", evicted)
+                telemetry.counter_add("cache.evicted_bytes", evicted_bytes)
+            with self._lock:
+                self._total_bytes = total
+
+    def _invalidate_path(self, path: str) -> None:
+        with contextlib.suppress(OSError):
+            os.remove(self._path_entry_path(path))
+
+    # -- read path -----------------------------------------------------------
+    async def read(self, read_io: ReadIO) -> None:
+        loop = asyncio.get_running_loop()
+        executor = self._get_executor()
+        path = read_io.path
+        entry, expect = self._entry_for(path)
+        verify = knobs.is_read_cache_verify_enabled()
+
+        # A ranged read spanning the WHOLE object (the scheduler expresses
+        # raw full-object reads as explicit ``(0, nbytes)`` ranges) is a
+        # full read in range clothing: eligible for populate, not bypass.
+        # Recognizable only when the digest index records the size.
+        full_range = (
+            read_io.byte_range is not None
+            and expect is not None
+            and read_io.byte_range[0] == 0
+            and read_io.byte_range[1] == expect[0]
+        )
+        if read_io.byte_range is not None and not full_range:
+            # Serve a range only from an already-cached full object; a miss
+            # passes through untouched so lazy partial restores never fetch
+            # more than the ranges they asked for.
+            data = await loop.run_in_executor(
+                executor, self._read_entry, entry, expect, verify
+            )
+            if data is None:
+                telemetry.counter_add("cache.bypass_reads")
+                await self.inner.read(read_io)
+                return
+            begin, end = read_io.byte_range
+            sliced = data[begin:end]
+            telemetry.counter_add("cache.hits")
+            telemetry.counter_add("cache.hit_bytes", len(sliced))
+            read_io.buf.write(sliced)
+            return
+
+        data = await loop.run_in_executor(
+            executor, self._read_entry, entry, expect, verify
+        )
+        if data is not None:
+            telemetry.counter_add("cache.hits")
+            telemetry.counter_add("cache.hit_bytes", len(data))
+            read_io.buf.write(data)
+            return
+
+        # Miss: fetch from origin (deduping concurrent fetches of one key),
+        # serve, and populate fail-open.
+        telemetry.counter_add("cache.misses")
+        pending = self._inflight.get(entry)
+        if pending is not None:
+            data = await asyncio.shield(pending)
+            telemetry.counter_add("cache.hit_bytes", len(data))
+            read_io.buf.write(data)
+            return
+        fut: asyncio.Future = loop.create_future()
+        self._inflight[entry] = fut
+        try:
+            await self.inner.read(read_io)
+            data = read_io.buf.getvalue()
+            fut.set_result(data)
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                # Peers awaiting the shared fetch see the failure; nobody
+                # retries through a half-set future.
+                with contextlib.suppress(BaseException):
+                    fut.exception()  # mark retrieved
+            raise
+        finally:
+            self._inflight.pop(entry, None)
+        telemetry.counter_add("cache.miss_bytes", len(data))
+        try:
+            with telemetry.span(
+                "storage.cache_populate",
+                cat="storage",
+                path=path,
+                nbytes=len(data),
+            ):
+                await loop.run_in_executor(
+                    executor, self._write_entry, entry, data
+                )
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "failed to populate read cache for %s (read served from "
+                "origin; caching disabled for this object)",
+                path,
+                exc_info=True,
+            )
+
+    # -- write/delete delegate (with path-entry invalidation) ----------------
+    async def write(self, write_io: WriteIO) -> None:
+        await self.inner.write(write_io)
+        await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), self._invalidate_path, write_io.path
+        )
+
+    async def write_stream(self, path: str) -> StorageWriteStream:
+        await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), self._invalidate_path, path
+        )
+        return await self.inner.write_stream(path)
+
+    async def delete(self, path: str) -> None:
+        await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), self._invalidate_path, path
+        )
+        await self.inner.delete(path)
+
+    async def link_in(self, src_abs_path: str, path: str) -> bool:
+        await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), self._invalidate_path, path
+        )
+        return await self.inner.link_in(src_abs_path, path)
+
+    async def list_prefix(self, prefix: str) -> List[str]:
+        return await self.inner.list_prefix(prefix)
+
+    async def prune_empty(self) -> None:
+        await self.inner.prune_empty()
+
+    async def close(self) -> None:
+        await self.inner.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+
+def maybe_wrap_with_read_cache(
+    plugin: StoragePlugin, origin_id: str
+) -> StoragePlugin:
+    """Wrap ``plugin`` when the read-cache knob points at a directory.
+    Called by ``url_to_storage_plugin`` on every plugin it constructs
+    (inside the fault wrapper, so chaos schedules inject through the cache
+    surface)."""
+    if not knobs.get_read_cache_dir():
+        return plugin
+    return CachedStoragePlugin(plugin, origin_id=origin_id)

@@ -154,7 +154,14 @@ class GCSStoragePlugin(StoragePlugin):
                 if not _is_transient(e) or self._progress.out_of_time():
                     raise
                 attempt += 1
-                backoff = backoff_s(attempt)
+                # Same window clamping retry_transient applies (PR 5): a
+                # backoff sleep never overshoots the collective-progress
+                # deadline by more than the epsilon, and the post-sleep
+                # re-check below surfaces the error promptly when nothing
+                # else made progress meanwhile.
+                backoff = min(
+                    backoff_s(attempt), self._progress.remaining_s() + 0.05
+                )
                 logger.warning(
                     "Transient GCS error mid-upload of %s at byte %d "
                     "(attempt %d, recovering cursor and retrying in %.1fs): %s",
@@ -165,6 +172,10 @@ class GCSStoragePlugin(StoragePlugin):
                     e,
                 )
                 await asyncio.sleep(backoff)
+                if self._progress.out_of_time():
+                    # The window expired during the sleep (and nothing else
+                    # made progress): surface the transient error now.
+                    raise
                 # Recover the server's persisted write cursor; the session
                 # repositions the source stream to it. recover() is
                 # idempotent, so it gets the same transient-retry treatment

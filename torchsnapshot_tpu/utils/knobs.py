@@ -737,6 +737,113 @@ def override_debug_ledger(enabled: bool):
     return _override_env(_ENV_DEBUG_LEDGER, "1" if enabled else "0")
 
 
+_ENV_READ_CACHE_DIR = "TORCHSNAPSHOT_TPU_READ_CACHE_DIR"
+_ENV_READ_CACHE_BYTES = "TORCHSNAPSHOT_TPU_READ_CACHE_BYTES"
+_ENV_READ_CACHE_VERIFY = "TORCHSNAPSHOT_TPU_READ_CACHE_VERIFY"
+
+_DEFAULT_READ_CACHE_BYTES = 10 * 1024 * 1024 * 1024
+
+
+def get_read_cache_dir() -> Optional[str]:
+    """Root directory of the content-addressed read-through cache. When set,
+    every storage plugin ``url_to_storage_plugin`` constructs is wrapped in a
+    :class:`~torchsnapshot_tpu.storage_plugins.cache.CachedStoragePlugin`
+    that serves repeat reads from this local store instead of the origin
+    backend — the serving-fleet knob (K replicas cold-starting from one
+    snapshot hit the origin once, not K times). Unset (the default) disables
+    the wrapper entirely; it is never even imported."""
+    return os.environ.get(_ENV_READ_CACHE_DIR) or None
+
+
+def get_read_cache_bytes() -> int:
+    """Byte budget of the local read-through cache store (default 10 GiB).
+    Exceeding it evicts least-recently-used entries after each populate."""
+    return max(0, _get_int(_ENV_READ_CACHE_BYTES, _DEFAULT_READ_CACHE_BYTES))
+
+
+def is_read_cache_verify_enabled() -> bool:
+    """Verify digest-keyed cache hits against their recorded sha256 before
+    serving (default on). A corrupt local entry then falls back to the
+    origin and is re-populated instead of silently serving bad bytes; the
+    cost is one hash pass per hit (~GB/s, GIL released)."""
+    return os.environ.get(_ENV_READ_CACHE_VERIFY, "1") not in (
+        "0",
+        "false",
+        "False",
+    )
+
+
+def override_read_cache_dir(path: str):
+    return _override_env(_ENV_READ_CACHE_DIR, path)
+
+
+def override_read_cache_bytes(value: int):
+    return _override_env(_ENV_READ_CACHE_BYTES, str(value))
+
+
+def override_read_cache_verify(enabled: bool):
+    return _override_env(_ENV_READ_CACHE_VERIFY, "1" if enabled else "0")
+
+
+_ENV_BCAST_RESTORE = "TORCHSNAPSHOT_TPU_BCAST_RESTORE"
+_ENV_BCAST_MAX_BYTES = "TORCHSNAPSHOT_TPU_BCAST_MAX_BYTES"
+
+_DEFAULT_BCAST_MAX_BYTES = 256 * 1024 * 1024
+
+
+def is_broadcast_restore_enabled(world_size: int, storage=None) -> bool:
+    """Single-reader + collective-broadcast restore for replicated entries:
+    one elected rank per object issues the storage read and the bytes fan
+    out over the coordinator store, collapsing N identical bucket reads to
+    one.
+
+    Default ``auto``: enabled at world > 1 against network/object stores
+    (gcs/s3 — where N identical GETs are the cold-start bottleneck),
+    disabled for local-disk-backed plugins (``scales_io_with_local_world``:
+    co-hosted ranks re-reading a local file is cheaper than a store
+    round-trip) and always at world 1. The broadcast rides the KV store —
+    no device collectives — so it works on any mesh/backend mix. ``1``/``0``
+    force it either way (still a no-op at world 1)."""
+    if world_size <= 1:
+        return False
+    val = os.environ.get(_ENV_BCAST_RESTORE, "auto").lower()
+    if val in ("auto", ""):
+        return not bool(getattr(storage, "scales_io_with_local_world", False))
+    return val not in ("0", "false", "off")
+
+
+def get_broadcast_max_bytes() -> int:
+    """Largest replicated object restored via broadcast (default 256 MB);
+    bigger ones fall back to per-rank reads. Bounds both the store payload
+    and the host RAM the broadcast phase holds at once."""
+    return max(1, _get_int(_ENV_BCAST_MAX_BYTES, _DEFAULT_BCAST_MAX_BYTES))
+
+
+def override_broadcast_restore(enabled: bool):
+    return _override_env(_ENV_BCAST_RESTORE, "1" if enabled else "0")
+
+
+def override_broadcast_max_bytes(value: int):
+    return _override_env(_ENV_BCAST_MAX_BYTES, str(value))
+
+
+_ENV_READ_MERGE_GAP = "TORCHSNAPSHOT_TPU_READ_MERGE_GAP_BYTES"
+
+
+def get_read_merge_gap_bytes() -> int:
+    """Max gap between two byte-range reads of one object that the read
+    batcher still coalesces into a single ranged request (default 0 =
+    exactly-adjacent only, the historical behavior). Lazy partial restores
+    of slab-batched subtrees produce near-adjacent member ranges; a small
+    gap tolerance trades a few discarded bytes for far fewer storage round
+    trips on high-latency backends."""
+    return max(0, _get_int(_ENV_READ_MERGE_GAP, 0))
+
+
+def override_read_merge_gap_bytes(value: int):
+    return _override_env(_ENV_READ_MERGE_GAP, str(value))
+
+
 _ENV_FAULTS = "TORCHSNAPSHOT_TPU_FAULTS"
 
 
