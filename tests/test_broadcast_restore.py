@@ -42,6 +42,19 @@ def test_elect_reader_stable_and_spread():
         assert len(seen) == world
 
 
+def test_reader_order_properties():
+    """The re-election order: starts at the sha1-elected reader, visits
+    every rank exactly once, and is identical across calls (every rank
+    derives the same order, so attempt N's reader is unambiguous)."""
+    for world in (2, 4, 8):
+        for i in range(16):
+            path = f"replicated/app/w{i}"
+            order = bcast.reader_order(path, None, world)
+            assert order[0] == bcast.elect_reader(path, None, world)
+            assert sorted(order) == list(range(world))
+            assert order == bcast.reader_order(path, None, world)
+
+
 def test_eligibility_rules():
     repl = ArrayEntry("replicated/x", "raw", "float32", [8], replicated=True)
     per_rank = ArrayEntry("0/x", "raw", "float32", [8], replicated=False)
@@ -159,4 +172,58 @@ def _worker_broadcast_restore(rank: int, world_size: int, shared: str) -> None:
 def test_broadcast_restore_multiprocess(tmp_path):
     run_with_processes(
         _worker_broadcast_restore, nproc=2, args=(str(tmp_path),)
+    )
+
+
+def _worker_broadcast_include_partial(rank: int, world_size: int, shared: str) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import bcast as bcast_mod
+    from torchsnapshot_tpu.parallel.coordinator import get_coordinator
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = os.path.join(shared, "ckpt")
+    state = StateDict(
+        w1=np.arange(500, dtype=np.float32),
+        w2=np.arange(500, 1000).astype(np.float64),
+        per_rank=np.full(7, rank, dtype=np.int32),
+    )
+    Snapshot.take(path, {"app": state}, replicated=["app/w*"])
+
+    # Partial restore of ONE replicated subtree with broadcast on: the
+    # include filter applies before eligibility planning and is
+    # SPMD-pure, so every rank plans the same (path, range) sequence —
+    # w1 broadcasts (exactly one origin reader fleet-wide), w2 and
+    # per_rank keep their live values untouched.
+    live_w2 = np.full(500, -7.0, dtype=np.float64)
+    live_pr = np.full(7, -7, dtype=np.int32)
+    tgt = StateDict(
+        w1=np.zeros(500, dtype=np.float32),
+        w2=live_w2.copy(),
+        per_rank=live_pr.copy(),
+    )
+    with _knobs.override_broadcast_restore(True):
+        Snapshot(path).restore({"app": tgt}, include=["app/w1"])
+    assert np.array_equal(tgt["w1"], state["w1"])
+    assert np.array_equal(tgt["w2"], live_w2), "excluded leaf was touched"
+    assert np.array_equal(tgt["per_rank"], live_pr), "excluded leaf was touched"
+
+    d = dict(bcast_mod.LAST_RESTORE_BCAST)
+    coord = get_coordinator()
+    gathered = coord.all_gather_object(d)
+    if rank == 0:
+        all_origin = [p for g in gathered for p in g["origin_reads"]]
+        # Exactly ONE rank read the single included replicated object; the
+        # excluded w2 was never read anywhere.
+        assert len(all_origin) == 1, gathered
+        assert sum(len(g["received"]) for g in gathered) == world_size - 1
+        assert all(g["entries"] == 1 for g in gathered), gathered
+
+
+def test_broadcast_restore_include_partial_multiprocess(tmp_path):
+    """Satellite: restore(include=) + broadcast interaction — a partial
+    restore where only some eligible entries match the glob still plans
+    identical sequences on every rank (no hang, one reader, excluded
+    leaves untouched)."""
+    run_with_processes(
+        _worker_broadcast_include_partial, nproc=2, args=(str(tmp_path),)
     )

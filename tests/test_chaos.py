@@ -16,8 +16,17 @@ failure, via ``TORCHSNAPSHOT_TPU_FAULTS`` (``faults.py``):
 - **gc** — after a crash, ``Snapshot.gc`` reclaims exactly the debris and a
   retake into the same parent succeeds.
 
+The RESTORE side (the read-path mirror, PR 9): every seeded read-fault
+schedule — transient storm, permanent failure, silent corruption
+(``kind=corrupt``), reader death — across fs / memory / fake-gcs, with the
+read cache and broadcast restore on and off, must end in either a
+bit-exact restore or a structured ``CheckpointAbortedError`` with
+rank/phase attribution; ``Snapshot.scrub`` must detect 100% of injected
+corruptions and ``--repair`` must restore replicated-content entries to
+digest-clean.
+
 The fast subset below runs in tier-1; the ``slow``-marked matrix replays
-20+ distinct seeded schedules across fs / memory / fake-gcs backends.
+the full schedule x backend grid.
 """
 
 from __future__ import annotations
@@ -748,3 +757,545 @@ def test_chaos_leader_death_between_arrive_and_depart(tmp_path) -> None:
     assert "rank 0" in msg and "died without reporting" in msg, msg
     assert os.path.exists(str(tmp_path / "survivor_1"))
     assert not os.path.exists(str(tmp_path / "cur" / ".snapshot_metadata"))
+
+
+# ---------------------------------------------------------------------------
+# Restore-side chaos: read faults, verification, scrub/repair (PR 9)
+# ---------------------------------------------------------------------------
+
+def _restore_round(
+    url: str,
+    spec: str,
+    expect_abort: bool,
+    verify_mode: str = "all",
+    cache_dir=None,
+):
+    """One restore-chaos scenario: commit a CLEAN snapshot, restore it under
+    an injected read-fault schedule, and assert the self-healing-restore
+    contract: the restore either completes bit-exact or raises a structured
+    ``CheckpointAbortedError`` in a ``restore.*`` phase — never a silently
+    corrupt load, never a hang. The snapshot itself must be untouched
+    either way (the read path writes nothing)."""
+    sep = "" if url.endswith("/") else "/"
+    snap_url = f"{url}{sep}snap"
+    src = _state(seed=4)["s"]
+    Snapshot.take(snap_url, _state(seed=4))
+    assert Snapshot(snap_url).verify() == {}
+
+    import contextlib as _ctx
+
+    cache_ctx = (
+        knobs.override_read_cache_dir(cache_dir)
+        if cache_dir
+        else _ctx.nullcontext()
+    )
+    tgt = {
+        "s": StateDict(
+            w=np.zeros(512, np.float32), b=np.zeros(64, np.int64), step=-1
+        )
+    }
+    aborted = None
+    with cache_ctx, knobs.override_verify_reads(verify_mode):
+        with knobs.override_faults(spec):
+            try:
+                Snapshot(snap_url).restore(tgt)
+            except CheckpointAbortedError as e:
+                aborted = e
+    if expect_abort:
+        assert aborted is not None, f"spec {spec!r} injected nothing fatal"
+        assert aborted.phase and aborted.phase.startswith("restore."), aborted
+    else:
+        assert aborted is None, aborted
+        assert np.array_equal(
+            tgt["s"]["w"].view(np.uint8), np.asarray(src["w"]).view(np.uint8)
+        )
+        assert np.array_equal(tgt["s"]["b"], src["b"])
+    # The snapshot is read-only to restore: still verifies clean, and a
+    # fault-free restore afterwards is bit-exact.
+    assert Snapshot(snap_url).verify() == {}
+    _assert_restores_bit_exact(snap_url, seed=4)
+    return aborted
+
+
+@pytest.mark.parametrize("any_backend", ["fs", "memory"], indirect=True)
+def test_chaos_restore_transient_read_storm_fast(any_backend) -> None:
+    """Transient read faults ride the retry machinery to a clean restore."""
+    _restore_round(
+        any_backend,
+        "backoff=0.005;op=read,kind=transient,times=3",
+        expect_abort=False,
+    )
+
+
+def test_chaos_restore_permanent_read_fault_aborts(tmp_path) -> None:
+    e = _restore_round(
+        str(tmp_path),
+        "op=read,kind=fail,path=0/s",
+        expect_abort=True,
+    )
+    assert e.phase == "restore.read", e
+    assert e.rank == 0, e
+    assert "injected" in str(e)
+
+
+def test_chaos_restore_corrupt_aborts_under_verification(tmp_path) -> None:
+    """Persistent silent corruption + VERIFY_READS=all: the verified
+    re-fetch is corrupt too, so the restore aborts instead of loading rot."""
+    e = _restore_round(
+        str(tmp_path),
+        "op=read,kind=corrupt,path=0/s",
+        expect_abort=True,
+    )
+    assert "verification" in e.detail or "verification" in str(e), e
+
+
+def test_chaos_restore_corrupt_oneshot_healed_by_refetch(tmp_path) -> None:
+    """One-shot corruption (at=0): verification catches it and the single
+    re-fetch returns clean bytes — restore completes bit-exact."""
+    _restore_round(
+        str(tmp_path),
+        "op=read,kind=corrupt,path=0/s,at=0",
+        expect_abort=False,
+    )
+
+
+def test_chaos_restore_corrupt_through_cache(tmp_path) -> None:
+    """Corrupt origin reads with the read-through cache in the stack: the
+    mismatch quarantines whatever the cache holds, the re-fetch repopulates,
+    and a SECOND restore is served digest-clean from the cache."""
+    cache_dir = str(tmp_path / "cache")
+    _restore_round(
+        str(tmp_path / "o"),
+        "op=read,kind=corrupt,path=0/s,at=0",
+        expect_abort=False,
+        cache_dir=cache_dir,
+    )
+    # Warm second restore, no faults: cache hits only, still bit-exact.
+    with knobs.override_read_cache_dir(cache_dir):
+        _assert_restores_bit_exact(str(tmp_path / "o") + "/snap", seed=4)
+
+
+def test_chaos_restore_unverified_corrupt_is_the_documented_gap(tmp_path) -> None:
+    """VERIFY_READS=off pins the contract boundary: persistent corruption
+    then loads silently — exactly the gap the verification knob (and scrub)
+    exists to close. If this ever starts aborting, the default changed and
+    the docs must follow."""
+    url = str(tmp_path / "snap")
+    src = _state(seed=4)["s"]
+    Snapshot.take(url, _state(seed=4))
+    tgt = {
+        "s": StateDict(
+            w=np.zeros(512, np.float32), b=np.zeros(64, np.int64), step=-1
+        )
+    }
+    with knobs.override_verify_reads("off"):
+        with knobs.override_faults("op=read,kind=corrupt,path=0/s/w"):
+            Snapshot(url).restore(tgt)
+    assert not np.array_equal(
+        tgt["s"]["w"].view(np.uint8), np.asarray(src["w"]).view(np.uint8)
+    ), "seeded corrupt fault flipped nothing?"
+
+
+def test_fault_spec_corrupt_grammar() -> None:
+    plan = parse_fault_spec("seed=3;op=read,kind=corrupt,bytes=4,at=1")
+    (rule,) = plan.rules
+    assert (rule.op, rule.kind, rule.bytes, rule.at) == ("read", "corrupt", 4, 1)
+    with pytest.raises(FaultSpecError):
+        parse_fault_spec("op=write,kind=corrupt")  # read-side only
+
+
+def test_corrupt_fault_is_deterministic(tmp_path) -> None:
+    """Same seed => identical flipped bytes, run to run."""
+
+    def corrupted_read(seed: int) -> bytes:
+        plugin = FaultyStoragePlugin(
+            _resolve_storage_plugin(str(tmp_path)),
+            parse_fault_spec(f"seed={seed};op=read,kind=corrupt,bytes=3"),
+        )
+
+        async def run() -> bytes:
+            await plugin.write(WriteIO(path="obj", buf=bytes(range(256))))
+            read_io = ReadIO(path="obj")
+            await plugin.read(read_io)
+            return read_io.buf.getvalue()
+
+        return _run(run())
+
+    a, b, c = corrupted_read(7), corrupted_read(7), corrupted_read(9)
+    assert a == b
+    assert a != bytes(range(256))
+    assert c != a  # different seed, different flips
+
+
+def test_ranged_read_retries_transient_oserror(tmp_path) -> None:
+    """Satellite: ranged (partial-extent) reads ride the transient-OSError
+    retry path end to end — both inside the fs plugin and at the
+    scheduler's read pipeline, which retries for ANY plugin."""
+    import errno
+
+    from torchsnapshot_tpu.scheduler import execute_read_reqs
+    from torchsnapshot_tpu.io_types import ReadReq, StoragePlugin
+
+    inner = _resolve_storage_plugin(str(tmp_path))
+    payload = bytes(range(200)) * 10
+
+    class FlakyRanged(StoragePlugin):
+        """Raises a transient OSError on the FIRST ranged read only —
+        modeling a plugin with no internal retry of its own."""
+
+        def __init__(self):
+            self.failures = 0
+
+        async def write(self, write_io):
+            await inner.write(write_io)
+
+        async def read(self, read_io):
+            if read_io.byte_range is not None and self.failures == 0:
+                self.failures += 1
+                raise OSError(errno.ESTALE, "stale handle (ranged)")
+            await inner.read(read_io)
+
+        async def delete(self, path):
+            await inner.delete(path)
+
+        async def close(self):
+            await inner.close()
+
+    plugin = FlakyRanged()
+    got = {}
+
+    class Consumer:
+        def get_consuming_cost_bytes(self):
+            return 64
+
+        async def consume_buffer(self, buf, executor=None):
+            got["data"] = bytes(buf)
+
+    async def run():
+        from torchsnapshot_tpu.storage_plugins import cloud_retry
+
+        await plugin.write(WriteIO(path="obj", buf=payload))
+        old = cloud_retry.BASE_BACKOFF_S
+        cloud_retry.BASE_BACKOFF_S = 0.001
+        try:
+            await execute_read_reqs(
+                [ReadReq(path="obj", buffer_consumer=Consumer(), byte_range=(100, 164))],
+                plugin,
+                memory_budget_bytes=1 << 20,
+                rank=0,
+            )
+        finally:
+            cloud_retry.BASE_BACKOFF_S = old
+
+    _run(run())
+    assert plugin.failures == 1, "the transient fault never fired"
+    assert got["data"] == payload[100:164], "retried ranged read returned wrong bytes"
+
+
+# ---------------------------------------------------------------------------
+# Scrub / repair
+# ---------------------------------------------------------------------------
+
+def _flip_file(path: str, offset: int = 0) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def test_scrub_detects_every_injected_corruption(tmp_path) -> None:
+    """Acceptance: scrub detects 100% of injected corruptions — one flipped
+    byte per object, across several objects — and a clean snapshot scrubs
+    clean."""
+    url = str(tmp_path / "s")
+    state = {
+        "s": StateDict(
+            **{
+                f"w{i}": np.random.default_rng(i).standard_normal(256).astype(
+                    np.float32
+                )
+                for i in range(4)
+            }
+        )
+    }
+    with knobs.override_dedup_digests(True):
+        Snapshot.take(url, state)
+    report = Snapshot(url).scrub()
+    assert report["clean"] and report["objects"] == 4, report
+
+    corrupted = [f"0/s/w{i}" for i in range(4)]
+    for i, rel in enumerate(corrupted):
+        _flip_file(os.path.join(url, rel), offset=i * 7)
+    report = Snapshot(url).scrub()
+    found = {
+        p for p, e in report["entries"].items() if e["status"] == "corrupt"
+    }
+    assert found == set(corrupted), (found, report)
+    assert report["corrupt"] == 4 and not report["clean"]
+
+
+def test_scrub_repair_heals_replicated_content_and_quarantines_rest(
+    tmp_path,
+) -> None:
+    """--repair: a corrupt object whose exact content survives at another
+    path (an alternate copy of the same replicated value, matched by
+    size+sha256) is rewritten digest-clean; one with no clean copy is
+    quarantined — moved aside so a restore fails fast instead of loading
+    rot."""
+    url = str(tmp_path / "s")
+    shared = np.arange(2048, dtype=np.float32)
+    unique = np.random.default_rng(1).standard_normal(512).astype(np.float32)
+    with knobs.override_dedup_digests(True):
+        Snapshot.take(
+            url,
+            {"s": StateDict(a=shared.copy(), b=shared.copy(), u=unique)},
+        )
+    _flip_file(os.path.join(url, "0/s/a"))  # repairable: 0/s/b holds a copy
+    _flip_file(os.path.join(url, "0/s/u"))  # unrepairable: content unique
+
+    report = Snapshot(url).scrub(repair=True)
+    assert report["repaired"] == 1 and report["quarantined"] == 1, report
+    assert report["entries"]["0/s/a"]["status"] == "repaired"
+    assert report["entries"]["0/s/u"]["status"] == "quarantined"
+    # Repaired object is digest-clean; quarantined one is gone (fail-fast).
+    assert Snapshot(url).scrub()["entries"]["0/s/a"]["status"] == "ok"
+    assert not os.path.exists(os.path.join(url, "0/s/u"))
+    assert os.path.exists(os.path.join(url, "0/s/u.quarantined"))
+    # gc reclaims the quarantined file as unreferenced debris.
+    gc_report = Snapshot.gc(url, dry_run=True)
+    assert "0/s/u.quarantined" in gc_report["remove"], gc_report
+
+
+def test_scrub_validates_ftab_frame_tables(tmp_path) -> None:
+    """A rotten .ftab (frame sizes no longer summing to the payload) is its
+    own detected problem class, even when the payload bytes are pristine."""
+    import json
+
+    url = str(tmp_path / "s")
+    big = np.random.default_rng(0).standard_normal(64 * 1024).astype(np.float32)
+    with knobs.override_compression("zlib"), knobs.override_compression_frame_bytes(
+        32 * 1024
+    ):
+        Snapshot.take(url, {"s": StateDict(w=big)})
+    ftabs = glob.glob(os.path.join(url, "**", "*.ftab"), recursive=True)
+    assert ftabs, "framed take wrote no frame table?"
+    report = Snapshot(url).scrub()
+    assert report["clean"], report
+
+    table = json.load(open(ftabs[0]))
+    table["sizes"][0] += 3
+    json.dump(table, open(ftabs[0], "w"))
+    report = Snapshot(url).scrub()
+    rel = os.path.relpath(ftabs[0], url)
+    assert report["entries"][rel]["status"] == "ftab-mismatch", report["entries"]
+
+
+def test_scrub_cli_exit_codes_and_repair(tmp_path, capsys) -> None:
+    from torchsnapshot_tpu.__main__ import main
+
+    url = str(tmp_path / "s")
+    shared = np.arange(1024, dtype=np.float32)
+    with knobs.override_dedup_digests(True):
+        Snapshot.take(url, {"s": StateDict(a=shared.copy(), b=shared.copy())})
+    assert main(["scrub", url]) == 0
+    assert "0 problem(s)" in capsys.readouterr().out
+
+    _flip_file(os.path.join(url, "0/s/a"))
+    assert main(["scrub", url]) == 1
+    assert "corrupt" in capsys.readouterr().err
+    assert main(["scrub", url, "--repair"]) == 0
+    out = capsys.readouterr().out
+    assert "repaired" in out
+    assert main(["scrub", url]) == 0  # digest-clean again
+
+
+# ---------------------------------------------------------------------------
+# Fast multiprocess: broadcast-reader death and re-election
+# ---------------------------------------------------------------------------
+
+def _worker_reader_killed_survivor_selfheals(rank, world_size, shared) -> None:
+    import json
+    import time as _time
+
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+        StateDict as SD,
+    )
+    from torchsnapshot_tpu import bcast as bcast_mod
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "8"
+    os.environ["TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"] = "1"
+    path = os.path.join(shared, "ckpt")
+    state = SD(
+        w1=_np.arange(500, dtype=_np.float32),
+        w2=_np.arange(500, 1000).astype(_np.float64),
+    )
+    Snap.take(path, {"app": state}, replicated=["app/*"])
+    # Kill rank 1 at its elected broadcast read (derived, not hard-coded,
+    # so the schedule survives election-spread changes).
+    locs = sorted(
+        {
+            getattr(e, "location", None)
+            for e in Snap(path).get_manifest().values()
+            if getattr(e, "location", None)
+        }
+    )
+    elected1 = [p for p in locs if bcast_mod.elect_reader(p, None, world_size) == 1]
+    assert elected1, "no object elected to rank 1; test state needs reshaping"
+    if rank == 1:
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = (
+            "op=read,kind=kill,path=" + elected1[0]
+        )
+    tgt = SD(w1=_np.zeros(500, _np.float32), w2=_np.zeros(500, _np.float64))
+    t0 = _time.monotonic()
+    try:
+        with _knobs.override_broadcast_restore(True), (
+            _knobs.override_bcast_reader_deadline_s(0.5)
+        ):
+            Snap(path).restore({"app": tgt})
+        raise AssertionError("restore must abort: a peer died mid-restore")
+    except Aborted as e:
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 60, f"abort took {elapsed:.1f}s (timeout 8s)"
+        assert e.phase and e.phase.startswith("restore."), e
+    # Only the survivor reaches here — and despite the dead reader it got
+    # EVERY byte (re-elected itself, read origin directly) before the
+    # structured abort at the post-load barrier.
+    assert _np.array_equal(tgt["w1"], state["w1"])
+    assert _np.array_equal(tgt["w2"], state["w2"])
+    d = dict(bcast_mod.LAST_RESTORE_BCAST)
+    assert d["reelections"] >= 1, d
+    with open(os.path.join(shared, f"survivor_{rank}.json"), "w") as f:
+        json.dump({"reelections": d["reelections"]}, f)
+
+
+@pytest.mark.multiprocess
+def test_chaos_restore_reader_killed_survivor_selfheals(tmp_path) -> None:
+    """Broadcast-reader death: the surviving peer detects the missed
+    deadline, re-elects itself, self-heals every byte from origin, and the
+    restore still ends in a structured abort (the fleet lost a rank) —
+    never a hang, never a partial load."""
+    with pytest.raises(RuntimeError) as exc_info:
+        run_with_processes(
+            _worker_reader_killed_survivor_selfheals, nproc=2,
+            args=(str(tmp_path),),
+        )
+    msg = str(exc_info.value)
+    assert "rank 1" in msg and f"(exitcode {KILL_EXIT_CODE})" in msg, msg
+    assert os.path.exists(str(tmp_path / "survivor_0.json"))
+
+
+def _worker_stalled_reader_reelection(rank, world_size, shared) -> None:
+    import json
+
+    import numpy as _np
+
+    from torchsnapshot_tpu import Snapshot as Snap, StateDict as SD
+    from torchsnapshot_tpu import bcast as bcast_mod
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = os.path.join(shared, "ckpt")
+    state = SD(
+        w1=_np.arange(500, dtype=_np.float32),
+        w2=_np.arange(500, 1000).astype(_np.float64),
+    )
+    Snap.take(path, {"app": state}, replicated=["app/*"])
+    locs = sorted(
+        {
+            getattr(e, "location", None)
+            for e in Snap(path).get_manifest().values()
+            if getattr(e, "location", None)
+        }
+    )
+    elected0 = [p for p in locs if bcast_mod.elect_reader(p, None, world_size) == 0]
+    assert elected0, "no object elected to rank 0"
+    if rank == 0:
+        # The elected reader stalls far past the reader deadline but stays
+        # alive: peers re-elect and finish; the stalled reader finishes too.
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = (
+            "op=read,kind=stall,secs=2,path=" + elected0[0]
+        )
+    tgt = SD(w1=_np.zeros(500, _np.float32), w2=_np.zeros(500, _np.float64))
+    with _knobs.override_broadcast_restore(True), (
+        _knobs.override_bcast_reader_deadline_s(0.3)
+    ):
+        Snap(path).restore({"app": tgt})
+    # BOTH ranks end bit-exact: re-election is availability, not abort.
+    assert _np.array_equal(tgt["w1"], state["w1"])
+    assert _np.array_equal(tgt["w2"], state["w2"])
+    d = dict(bcast_mod.LAST_RESTORE_BCAST)
+    with open(os.path.join(shared, f"diag_{rank}.json"), "w") as f:
+        json.dump({"reelections": d["reelections"]}, f)
+
+
+@pytest.mark.multiprocess
+def test_chaos_restore_stalled_reader_reelected_both_ranks_complete(
+    tmp_path,
+) -> None:
+    """A slow-but-alive elected reader: the waiting peer re-elects past the
+    deadline and completes; the stalled reader completes too (its late post
+    lands under its own attempt fence and corrupts nothing)."""
+    import json
+
+    run_with_processes(
+        _worker_stalled_reader_reelection, nproc=2, args=(str(tmp_path),)
+    )
+    diags = [
+        json.load(open(str(tmp_path / f"diag_{r}.json"))) for r in range(2)
+    ]
+    assert sum(d["reelections"] for d in diags) >= 1, diags
+
+
+# ---------------------------------------------------------------------------
+# The slow restore matrix: read-fault schedules x backends x cache
+# ---------------------------------------------------------------------------
+
+_RESTORE_ABORT_SCHEDULES = [
+    # Permanent failures at data objects and at planning metadata.
+    "op=read,kind=fail,path=0/s",
+    "op=read,at=2,kind=fail",
+    "op=read,kind=fail,path=.snapshot_metadata",
+    # A transient storm that outlives the (shrunk) progress window.
+    "backoff=0.01;window=0.05;op=read,kind=transient,path=0/s",
+    # Persistent corruption: every fetch (and the verified re-fetch) rots.
+    "op=read,kind=corrupt,path=0/s",
+    "seed=5;op=read,kind=corrupt,bytes=8,path=0/s",
+]
+
+_RESTORE_RESILIENT_SCHEDULES = [
+    # Transient storms under the default window: retried to success.
+    "backoff=0.005;op=read,kind=transient,times=4",
+    "backoff=0.005;seed=7;op=read,p=0.4,kind=transient,times=6",
+    # One-shot corruption: caught by verification, healed by the re-fetch.
+    "op=read,kind=corrupt,at=0,path=0/s",
+    "seed=11;op=read,kind=corrupt,at=1,bytes=4,path=0/s",
+    # Stalls delay but never fail.
+    "op=read,kind=stall,secs=0.05,times=3",
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("with_cache", [False, True], ids=["nocache", "cache"])
+@pytest.mark.parametrize("spec", _RESTORE_ABORT_SCHEDULES)
+@pytest.mark.parametrize("any_backend", ["fs", "memory", "gcs"], indirect=True)
+def test_chaos_matrix_restore_aborting_schedules(
+    any_backend, spec, with_cache, tmp_path
+) -> None:
+    cache_dir = str(tmp_path / "rcache") if with_cache else None
+    _restore_round(any_backend, spec, expect_abort=True, cache_dir=cache_dir)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("with_cache", [False, True], ids=["nocache", "cache"])
+@pytest.mark.parametrize("spec", _RESTORE_RESILIENT_SCHEDULES)
+@pytest.mark.parametrize("any_backend", ["fs", "memory", "gcs"], indirect=True)
+def test_chaos_matrix_restore_resilient_schedules(
+    any_backend, spec, with_cache, tmp_path
+) -> None:
+    cache_dir = str(tmp_path / "rcache") if with_cache else None
+    _restore_round(any_backend, spec, expect_abort=False, cache_dir=cache_dir)

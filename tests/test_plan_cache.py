@@ -434,10 +434,14 @@ def _worker_restore_constant_round_trips(rank, world_size, shared):
 
     small_counts, small_ops = measured_restore(small, 2)
     big_counts, big_ops = measured_restore(big, 6)
-    # Key union + hostname (memory budget) each one gather+broadcast, plus
-    # ONE post-load barrier, no all_gathers — the same collective shape and
-    # store-op count regardless of key count.
-    expected = {"all_gather": 0, "gather": 2, "broadcast": 2, "barrier": 1}
+    # Key union + hostname (memory budget) each one gather+broadcast, no
+    # all_gathers — the same collective shape and store-op count
+    # regardless of key count. The single post-load rendezvous is a
+    # LinearBarrier (store ops, counted in small_ops/big_ops below — still
+    # one per restore), not a coordinator barrier: a failing or dead peer
+    # then fails this rank promptly with rank/phase attribution instead of
+    # a bare timeout.
+    expected = {"all_gather": 0, "gather": 2, "broadcast": 2, "barrier": 0}
     assert small_counts == expected, small_counts
     assert big_counts == expected, big_counts
     # The barrier-release `set` lands on whichever rank arrives last, so a

@@ -347,3 +347,59 @@ def test_populate_failure_is_fail_open(tmp_path):
     assert read(plugin, "obj") == b"k" * 100  # origin again, still correct
     assert inner.reads == 2
     run(plugin.close())
+
+
+def test_eviction_never_touches_pinned_entries(tmp_path):
+    """Satellite: LRU eviction skips entries that are mid-populate or have
+    an in-flight reader. With every resident entry pinned, a populate that
+    overflows the byte budget evicts nothing (the store transiently
+    exceeds the budget rather than tear a concurrent read); unpinned, the
+    same populate evicts the LRU entry."""
+    import os as _os
+    import time as _time
+
+    plugin, inner = make_cache(tmp_path, max_bytes=1500)
+    seed(inner, "a", b"a" * 1000)
+    seed(inner, "b", b"b" * 1000)
+    read(plugin, "a")  # resident
+    entry_a = plugin._path_entry_path("a")
+    assert _os.path.exists(entry_a)
+
+    plugin._pin(entry_a)
+    try:
+        _time.sleep(0.02)  # entry_a is strictly the LRU candidate
+        read(plugin, "b")  # populate overflows the 1500-byte budget
+        assert _os.path.exists(entry_a), "evicted a pinned (in-flight) entry"
+    finally:
+        plugin._unpin(entry_a)
+    # Unpinned, the same overflow evicts it.
+    plugin._maybe_evict()
+    assert not _os.path.exists(entry_a)
+    run(plugin.close())
+
+
+def test_quarantine_path_removes_digest_and_path_entries(tmp_path):
+    """The read pipeline's mismatch handler: quarantining a path unlinks
+    BOTH the digest-keyed content entry and the path-keyed entry, so bytes
+    that failed verification upstream are never served twice."""
+    import hashlib as _hashlib
+    import os as _os
+
+    plugin, inner = make_cache(tmp_path)
+    data = b"q" * 500
+    sha = _hashlib.sha256(data).hexdigest()
+    plugin.attach_digest_index({"obj": (len(data), sha, None)})
+    seed(inner, "obj", data)
+    read(plugin, "obj")  # populates the digest-keyed entry
+    digest_entry = plugin._digest_entry_path(sha)
+    assert _os.path.exists(digest_entry)
+
+    removed = plugin.quarantine_path("obj")
+    assert removed == 1, removed
+    assert not _os.path.exists(digest_entry)
+    # Next read misses and repopulates from origin.
+    before = inner.reads
+    assert read(plugin, "obj") == data
+    assert inner.reads == before + 1
+    assert _os.path.exists(digest_entry)
+    run(plugin.close())

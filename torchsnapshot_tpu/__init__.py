@@ -14,6 +14,7 @@ The public interface is deliberately tiny (reference ``__init__.py:35-41``):
 
 from .io_types import StoragePlugin
 from .rng_state import RNGState
+from .scheduler import ReadVerificationError
 from .snapshot import CheckpointAbortedError, PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
@@ -28,5 +29,6 @@ __all__ = [
     "AppState",
     "StoragePlugin",
     "CheckpointAbortedError",
+    "ReadVerificationError",
     "__version__",
 ]

@@ -14,6 +14,16 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         CRC32-audit every storage object against the recorded sidecars;
         exit code 1 if any problem is found.
 
+    python -m torchsnapshot_tpu scrub <snapshot-path> [--repair] [--json]
+        Deep integrity sweep: stream every committed object through the
+        budgeted read machinery and validate bytes against the sidecar
+        digests (size + sha256/crc32) AND every ``.ftab`` frame table;
+        prints a per-entry report. ``--repair`` rewrites corrupt/missing
+        objects from a verified identical-content copy elsewhere in the
+        snapshot (an alternate rank's replica) and quarantines unrepairable
+        corrupt objects so restores fail fast instead of consuming rot.
+        Exit code 1 if unresolved problems remain. See docs/robustness.md.
+
     python -m torchsnapshot_tpu trace <snapshot-path> [-o trace.json]
         Traced read of every storage object the manifest references, under
         the usual memory budget + IO concurrency caps; writes a Chrome/
@@ -109,6 +119,34 @@ def _cmd_verify(args: argparse.Namespace) -> int:
         print(f"{path}: {problem}", file=sys.stderr)
     print(f"{len(problems)} problem(s) found", file=sys.stderr)
     return 1
+
+
+def _cmd_scrub(args: argparse.Namespace) -> int:
+    import json
+
+    from .snapshot import Snapshot
+
+    report = Snapshot(args.path).scrub(repair=args.repair)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["clean"] else 1
+    for path, e in sorted(report["entries"].items()):
+        if e["status"] == "ok":
+            continue
+        detail = f" ({e['detail']})" if e["detail"] else ""
+        line = f"{path}: {e['status']}{detail}"
+        if e["status"] == "repaired":
+            print(line)
+        else:
+            print(line, file=sys.stderr)
+    print(
+        f"scrubbed {report['objects']} object(s), "
+        f"{report['bytes'] / 1e9:.3f} GB: "
+        f"{report['problems']} problem(s), "
+        f"{report['repaired']} repaired, "
+        f"{report['quarantined']} quarantined"
+    )
+    return 0 if report["clean"] else 1
 
 
 def _cmd_trace(args: argparse.Namespace) -> int:
@@ -292,6 +330,30 @@ def main(argv=None) -> int:
     p_verify = sub.add_parser("verify", help="CRC32-audit the snapshot")
     p_verify.add_argument("path")
     p_verify.set_defaults(fn=_cmd_verify)
+
+    p_scrub = sub.add_parser(
+        "scrub",
+        help=(
+            "deep integrity sweep: validate every object against sidecar "
+            "digests + .ftab frame tables; --repair self-heals from "
+            "replicated copies and quarantines the rest"
+        ),
+    )
+    p_scrub.add_argument("path")
+    p_scrub.add_argument(
+        "--repair",
+        action="store_true",
+        help=(
+            "rewrite corrupt/missing objects from a verified identical-"
+            "content copy; quarantine unrepairable corrupt objects"
+        ),
+    )
+    p_scrub.add_argument(
+        "--json",
+        action="store_true",
+        help="print the full structured report as JSON",
+    )
+    p_scrub.set_defaults(fn=_cmd_scrub)
 
     p_trace = sub.add_parser(
         "trace",

@@ -6,18 +6,18 @@ object against the origin bucket. With broadcast restore on
 (``TORCHSNAPSHOT_TPU_BCAST_RESTORE``), each replicated object elects one
 reader (stable hash of the object path, so the read load spreads across
 ranks), the elected rank issues the storage read, and the bytes fan out to
-every peer through the coordinator's KV-store broadcast — collapsing N
-origin reads to 1 per object. Consumers and finalizers (``device_put`` onto
-the live target's sharding — the ``get_replicate_sharding`` pattern) then
-run per rank exactly as they would for a locally-read buffer.
+every peer through the coordinator's KV store — collapsing N origin reads
+to 1 per object. Consumers and finalizers (``device_put`` onto the live
+target's sharding — the ``get_replicate_sharding`` pattern) then run per
+rank exactly as they would for a locally-read buffer.
 
 Design constraints, and how they are met:
 
-- **No device collectives.** The fan-out rides the same generation-counted
-  store broadcasts the planner uses, so it works on any backend mix (CPU,
-  TPU, mixed pods) and off the main thread never touches XLA.
+- **No device collectives.** The fan-out rides plain coordinator-store
+  keys, so it works on any backend mix (CPU, TPU, mixed pods) and off the
+  main thread never touches XLA.
 - **SPMD symmetry.** Every rank must plan the exact same broadcast sequence
-  or the store collectives deadlock. Eligibility is therefore a pure
+  or peers wait on keys nobody posts. Eligibility is therefore a pure
   function of the (identical-everywhere) manifest entry plus env knobs —
   never of per-rank state like the memory budget — and eligible entries are
   planned with no budget sub-read limit so their read requests (path, byte
@@ -27,11 +27,31 @@ Design constraints, and how they are met:
 - **Bounded memory.** Objects above ``TORCHSNAPSHOT_TPU_BCAST_MAX_BYTES``
   fall back to per-rank reads; the broadcast phase holds at most the
   elected-rank fetches plus one in-flight broadcast payload.
+- **Fault tolerance: broadcast mode is never less available than direct
+  mode.** Payload keys are fenced by a per-restore token AND a per-object
+  attempt counter. A peer that sees no payload (or error marker) from the
+  elected reader within ``TORCHSNAPSHOT_TPU_BCAST_READER_DEADLINE_S``
+  declares the reader dead and **re-elects the next rank in the sha1
+  order** — the new reader notices its own election the same way (its wait
+  for the previous attempt expires) and serves the object under the next
+  attempt's key, so a slow old reader posting late can never corrupt a
+  newer attempt. After ``TORCHSNAPSHOT_TPU_BCAST_REELECT_MAX`` re-elections
+  every peer falls back to a DIRECT origin read. A reader whose origin read
+  fails permanently posts an error marker so peers skip straight to the
+  direct fallback instead of waiting out deadlines. When the snapshot's
+  checksum sidecars are available (and ``TORCHSNAPSHOT_TPU_VERIFY_READS``
+  is not ``off``), every payload a reader fans out is digest-verified first
+  — with one re-fetch on mismatch — because a corrupt broadcast would
+  amplify one rank's bit rot to the whole fleet. The PR 4 stall watchdog
+  (``TORCHSNAPSHOT_TPU_STALL_WARN_S``) is armed around the wait loop, so a
+  fleet waiting on a dead reader logs a structured stall warning instead of
+  sitting silent.
 
 ``LAST_RESTORE_BCAST`` records the most recent restore's broadcast activity
-per process (origin reads issued here vs payloads received) — the
-benchmark/chaos surface asserting "exactly one rank read each replicated
-object from storage".
+per process (origin reads issued here vs payloads received, re-elections,
+direct fallbacks) — the benchmark/chaos surface asserting "exactly one rank
+read each replicated object from storage" and "reader death degrades, never
+strands".
 """
 
 from __future__ import annotations
@@ -39,6 +59,8 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import telemetry
@@ -52,14 +74,26 @@ from .manifest import (
     ShardedArrayEntry,
     is_replicated,
 )
+from .scheduler import (
+    ReadVerificationError,
+    _read_digest_record,
+    _verify_mismatch,
+)
 from .utils import knobs
 
 logger = logging.getLogger(__name__)
 
 # Diagnostics of this process's most recent restore (reset by
 # ``Snapshot.restore``): which (path, byte_range) keys THIS rank read from
-# origin storage, which it received via broadcast, and the byte totals.
+# origin storage, which it received via broadcast, the byte totals, and the
+# fault-tolerance record (re-elections this rank declared, direct-origin
+# fallbacks it took).
 LAST_RESTORE_BCAST: Dict[str, Any] = {}
+
+# Payload key markers: one byte prefixed to the raw object bytes so an
+# error report can ride the same fenced key as a payload.
+_OK = b"O"
+_ERR = b"E"
 
 
 def reset_diagnostics() -> None:
@@ -71,6 +105,8 @@ def reset_diagnostics() -> None:
             "origin_bytes": 0,
             "recv_bytes": 0,
             "entries": 0,
+            "reelections": 0,
+            "direct_fallbacks": 0,
         }
     )
 
@@ -135,6 +171,18 @@ def elect_reader(path: str, byte_range: Optional[Tuple[int, int]], world: int) -
     ) % max(1, world)
 
 
+def reader_order(
+    path: str, byte_range: Optional[Tuple[int, int]], world: int
+) -> List[int]:
+    """The full re-election order for one object: the sha1-elected reader
+    followed by its successors modulo world. Attempt ``a``'s reader is
+    ``order[a]``; every rank derives the identical order, so a peer that
+    times out on attempt ``a`` knows exactly who serves attempt ``a+1`` —
+    including whether that is itself."""
+    first = elect_reader(path, byte_range, world)
+    return [(first + i) % max(1, world) for i in range(max(1, world))]
+
+
 class BroadcastItem:
     """One eligible entry's planned reads + finalizer."""
 
@@ -151,49 +199,301 @@ class BroadcastItem:
         self.finalize = finalize
 
 
+class _BcastSession:
+    """One ``run_broadcast`` call's store namespace + fetch/verify plumbing.
+
+    Keys live under ``bcastx/<token>/<object-index>/<attempt>`` where the
+    token is broadcast from rank 0 once per session — generation fencing
+    across restores — and the attempt counter fences re-elections within
+    one object. Posted payload keys are registered with the coordinator's
+    deferred-delete GC, so the store reclaims them after the restore's
+    final barrier like any other collective key."""
+
+    def __init__(self, coord, storage: StoragePlugin, executor, digests) -> None:
+        self.coord = coord
+        self.storage = storage
+        self.executor = executor
+        self.digests = digests
+        self.rank = coord.get_rank()
+        self.world = coord.get_world_size()
+        token = coord.broadcast_object(
+            uuid.uuid4().hex[:12] if self.rank == 0 else None, src=0
+        )
+        self.prefix = f"bcastx/{token}"
+        self.ns = coord.store.prefix(self.prefix)
+        self.verify = knobs.get_verify_reads_mode() != "off" and bool(digests)
+        self._quarantine_cache = None
+        if self.verify:
+            from .storage_plugins.cache import find_read_cache
+
+            self._quarantine_cache = find_read_cache(storage)
+
+    # ------------------------------------------------------------ store I/O
+    async def _store_call(self, fn, *args):
+        """Blocking store ops off the event loop, so the stall watchdog
+        (and any concurrent fetch) keeps running during a slow round trip."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self.executor, fn, *args
+        )
+
+    async def post(self, idx: int, attempt: int, payload: bytes) -> None:
+        key = f"{idx}/{attempt}"
+        await self._store_call(self.ns.set, key, payload)
+        # Reclaimed after the next completed full-world barrier (the
+        # restore's own post-load barrier), like collective keys.
+        self.coord.defer_delete(f"{self.prefix}/{key}")
+
+    async def try_get(self, idx: int, attempt: int) -> Optional[bytes]:
+        return await self._store_call(self.ns.try_get, f"{idx}/{attempt}")
+
+    # ------------------------------------------------------- verified fetch
+    async def fetch_verified(
+        self, key: Tuple[str, Optional[Tuple[int, int]]]
+    ) -> bytes:
+        """One origin read of ``key``, digest-verified when the sidecars
+        cover it (full-object reads only), with one quarantine + re-fetch
+        on mismatch — a reader must never fan corrupt bytes out to the
+        fleet, and a peer's direct fallback must be as safe as the
+        pipeline's reads."""
+        loop = asyncio.get_running_loop()
+        path, byte_range = key
+
+        async def fetch_once() -> bytes:
+            read_io = ReadIO(path=path, byte_range=byte_range)
+            await self.storage.read(read_io)
+            return read_io.buf.getvalue()
+
+        data = await fetch_once()
+        want = _read_digest_record(self.digests, path) if self.verify else None
+        full_object = want is not None and (
+            byte_range is None
+            or (byte_range[0] == 0 and byte_range[1] == want[1])
+        )
+        if not full_object:
+            return data
+        problem = await loop.run_in_executor(
+            self.executor, _verify_mismatch, memoryview(data), want
+        )
+        if problem is None:
+            return data
+        telemetry.counter_add("bcast.verify_failures")
+        logger.warning(
+            "broadcast read of %s failed digest verification (%s); "
+            "quarantining cache entries and re-fetching once",
+            path,
+            problem,
+        )
+        if self._quarantine_cache is not None:
+            await loop.run_in_executor(
+                self.executor, self._quarantine_cache.quarantine_path, path
+            )
+        data = await fetch_once()
+        problem = await loop.run_in_executor(
+            self.executor, _verify_mismatch, memoryview(data), want
+        )
+        if problem is not None:
+            telemetry.counter_add("bcast.verify_failures")
+            raise ReadVerificationError(
+                f"broadcast read of {path} failed digest verification twice "
+                f"({problem}); refusing to fan corrupt bytes out to the fleet"
+            )
+        return data
+
+
 def run_broadcast(
     items: List[BroadcastItem],
     storage: StoragePlugin,
     coord,
     event_loop: asyncio.AbstractEventLoop,
     executor=None,
+    digests: Optional[Dict[str, object]] = None,
 ) -> None:
     """Execute the broadcast phase for one stateful's eligible entries.
 
     Called at the same program point on every rank with an identical
-    ``items`` sequence (SPMD). The elected reads run concurrently through
-    the origin plugin first; the broadcasts then proceed in deterministic
-    order, each immediately consumed (deserialize + scatter into the
-    target) and finalized."""
+    ``items`` sequence (SPMD). The attempt-0 elected reads run concurrently
+    through the origin plugin first (each payload posted the moment it is
+    fetched); the objects are then consumed in deterministic order, each
+    either served from this rank's own fetch, received from the elected
+    reader's fenced store key, obtained after re-electing dead readers, or
+    — past the re-election budget — read directly from origin. ``digests``
+    (the snapshot's parsed checksum sidecars) enables payload verification.
+    """
     if not items:
         return
-    rank = coord.get_rank()
-    world = coord.get_world_size()
     if not LAST_RESTORE_BCAST:
         reset_diagnostics()
+    rank = coord.get_rank()
+    world = coord.get_world_size()
+    session = _BcastSession(coord, storage, executor, digests)
 
+    # Deterministic (identical on every rank) object-key order; index IS
+    # the store-key fence for the object.
     keys: List[Tuple[str, Optional[Tuple[int, int]]]] = []
+    key_to_idx: Dict[Tuple[str, Optional[Tuple[int, int]]], int] = {}
     for item in items:
         for req in item.reqs:
-            keys.append((req.path, req.byte_range))
-    assigned = [k for k in keys if elect_reader(k[0], k[1], world) == rank]
+            key = (req.path, req.byte_range)
+            if key not in key_to_idx:
+                key_to_idx[key] = len(keys)
+                keys.append(key)
+    orders = {key: reader_order(key[0], key[1], world) for key in keys}
 
     fetched: Dict[Tuple[str, Optional[Tuple[int, int]]], bytes] = {}
+    deadline_s = knobs.get_bcast_reader_deadline_s()
+    # order[] has ``world`` distinct entries; past that, re-election would
+    # wrap back to already-dead readers.
+    max_attempts = 1 + min(knobs.get_bcast_reelect_max(), world - 1)
+
+    # Wait-loop liveness plumbing: payload arrivals (fetched, received, or
+    # direct-fallback) count as byte progress, so the PR 4 stall watchdog
+    # names a silent fleet-wide wait instead of letting it pass unobserved.
+    tracker = telemetry.ProgressTracker()
+    tracker.set_totals(requests=len(keys), bytes_=0)
+    pending_count = [len(keys)]
 
     async def fetch_assigned() -> None:
         sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
 
         async def fetch_one(key) -> None:
-            if key in fetched:
-                return
+            idx = key_to_idx[key]
             async with sem:
-                read_io = ReadIO(path=key[0], byte_range=key[1])
-                await storage.read(read_io)
-                fetched[key] = read_io.buf.getvalue()
+                try:
+                    data = await session.fetch_verified(key)
+                except Exception as e:  # noqa: BLE001 - reported to peers
+                    # Peers skip straight to their direct fallback instead
+                    # of waiting out the reader deadline; this rank retries
+                    # direct itself at consume time (a one-shot fault may
+                    # have cleared) and aborts if that fails too.
+                    logger.warning(
+                        "elected reader failed origin read of %s: %r; "
+                        "posting error marker",
+                        key[0],
+                        e,
+                    )
+                    await session.post(idx, 0, _ERR + repr(e).encode())
+                    return
+            fetched[key] = data
+            tracker.note_staged(len(data))
+            # Post the payload the moment it lands so peers' deadlines
+            # never charge for unrelated objects still fetching.
+            await session.post(idx, 0, _OK + data)
 
-        await asyncio.gather(*(fetch_one(k) for k in dict.fromkeys(assigned)))
+        assigned = [k for k in keys if orders[k][0] == rank]
+        await asyncio.gather(*(fetch_one(k) for k in assigned))
 
-    event_loop.run_until_complete(fetch_assigned())
+    async def obtain(key) -> Tuple[bytes, str]:
+        """This rank's bytes for one object: (data, how) with ``how`` one
+        of ``fetched`` | ``received`` | ``direct``."""
+        idx = key_to_idx[key]
+        order = orders[key]
+        poll_s = max(0.01, min(0.05, deadline_s / 10.0))
+        for attempt in range(max_attempts):
+            reader = order[attempt]
+            if reader == rank:
+                if key in fetched:
+                    return fetched[key], "fetched"
+                # Re-elected (or the attempt-0 fetch failed and posted an
+                # error): serve the object under THIS attempt's fenced key.
+                try:
+                    data = await session.fetch_verified(key)
+                except Exception as e:  # noqa: BLE001 - reported to peers
+                    await session.post(idx, attempt, _ERR + repr(e).encode())
+                    raise
+                await session.post(idx, attempt, _OK + data)
+                fetched[key] = data  # a re-elected fetch IS an origin read
+                tracker.note_staged(len(data))
+                return data, "fetched"
+            deadline = time.monotonic() + deadline_s
+            while True:
+                payload = await session.try_get(idx, attempt)
+                if payload is not None:
+                    if payload[:1] == _OK:
+                        data = payload[1:]
+                        tracker.note_staged(len(data))
+                        return data, "received"
+                    # Error marker: the reader reached origin and failed
+                    # permanently. Waiting longer proves nothing — fall
+                    # back to a direct read (the fault may be scoped to
+                    # the reader's rank).
+                    logger.warning(
+                        "broadcast reader rank %d reported a failed read "
+                        "of %s (%s); falling back to a direct origin read",
+                        reader,
+                        key[0],
+                        payload[1:].decode(errors="replace"),
+                    )
+                    break
+                if time.monotonic() >= deadline:
+                    if attempt + 1 < max_attempts:
+                        telemetry.counter_add("bcast.reelections")
+                        LAST_RESTORE_BCAST["reelections"] += 1
+                        logger.warning(
+                            "broadcast reader rank %d missed the %.1fs "
+                            "deadline for %s; re-electing rank %d "
+                            "(attempt %d)",
+                            reader,
+                            deadline_s,
+                            key[0],
+                            order[attempt + 1],
+                            attempt + 1,
+                        )
+                    break
+                await asyncio.sleep(poll_s)
+            if payload is not None and payload[:1] == _ERR:
+                break  # error marker: straight to the direct fallback
+        # Re-election budget exhausted (or the reader hit a permanent
+        # origin error): direct origin read. Broadcast mode can never be
+        # less available than direct mode — a peer that can reach the
+        # origin always makes progress.
+        telemetry.counter_add("bcast.direct_fallbacks")
+        LAST_RESTORE_BCAST["direct_fallbacks"] += 1
+        data = await session.fetch_verified(key)
+        tracker.note_staged(len(data))
+        return data, "direct"
+
+    async def drive() -> None:
+        watchdog_task = None
+        warn_s = knobs.get_stall_warn_s()
+        if warn_s > 0:
+            watchdog = telemetry.StallWatchdog(
+                tracker,
+                warn_s,
+                occupancy=lambda: {"bcast_wait": pending_count[0]},
+                rank=rank,
+                on_fire=lambda: telemetry.counter_add(
+                    "scheduler.stall_warnings", 1
+                ),
+            )
+            watchdog_task = asyncio.ensure_future(watchdog.run())
+        try:
+            await fetch_assigned()
+            obtained: Dict[Tuple[str, Optional[Tuple[int, int]]], Tuple[bytes, str]] = {}
+            for item in items:
+                for req in item.reqs:
+                    key = (req.path, req.byte_range)
+                    if key not in obtained:
+                        obtained[key] = await obtain(key)
+                        pending_count[0] -= 1
+                        tracker.note_request_done()
+                    data, how = obtained[key]
+                    if how == "received":
+                        telemetry.counter_add("bcast.recv_bytes", len(data))
+                        LAST_RESTORE_BCAST["received"].append(key[0])
+                        LAST_RESTORE_BCAST["recv_bytes"] += len(data)
+                    await req.buffer_consumer.consume_buffer(
+                        memoryview(data), executor
+                    )
+                if item.finalize is not None:
+                    item.finalize()
+        finally:
+            if watchdog_task is not None:
+                watchdog_task.cancel()
+                await asyncio.gather(watchdog_task, return_exceptions=True)
+
+    telemetry.counter_add("bcast.entries", len(items))
+    LAST_RESTORE_BCAST["entries"] += len(items)
+    event_loop.run_until_complete(drive())
     origin_bytes = sum(len(v) for v in fetched.values())
     if fetched:
         telemetry.counter_add("bcast.origin_reads", len(fetched))
@@ -202,21 +502,3 @@ def run_broadcast(
             sorted(k[0] for k in fetched)
         )
         LAST_RESTORE_BCAST["origin_bytes"] += origin_bytes
-
-    telemetry.counter_add("bcast.entries", len(items))
-    LAST_RESTORE_BCAST["entries"] += len(items)
-    for item in items:
-        for req in item.reqs:
-            key = (req.path, req.byte_range)
-            src = elect_reader(key[0], key[1], world)
-            payload = fetched.get(key) if rank == src else None
-            data = coord.broadcast_object(payload, src=src)
-            if rank != src:
-                telemetry.counter_add("bcast.recv_bytes", len(data))
-                LAST_RESTORE_BCAST["received"].append(key[0])
-                LAST_RESTORE_BCAST["recv_bytes"] += len(data)
-            event_loop.run_until_complete(
-                req.buffer_consumer.consume_buffer(memoryview(data), executor)
-            )
-        if item.finalize is not None:
-            item.finalize()

@@ -34,6 +34,13 @@ Spec grammar (rules separated by ``;``, fields by ``,``)::
           | stall      sleep `secs` seconds before the op (drives the
           |            stall watchdog)
           | kill       os._exit the process at the op (preemption)
+          | corrupt    read ops only: the read SUCCEEDS but `bytes` bytes
+          |            (default 1) of the returned buffer are flipped at
+          |            seeded offsets — silent bit rot, the failure mode
+          |            digest verification (TORCHSNAPSHOT_TPU_VERIFY_READS,
+          |            cache-hit verification, Snapshot.scrub) exists to
+          |            catch. No error is raised: an unverified reader
+          |            consumes the corrupt bytes without noticing.
 
     fields:
       at=<k>        inject at the k-th op of this class (0-based; once)
@@ -45,7 +52,8 @@ Spec grammar (rules separated by ``;``, fields by ``,``)::
                     `at`, unlimited otherwise)
       rank=<r>      only inject on this rank (env rank / jax process index)
       path=<substr> only inject on ops whose path contains this substring
-      bytes=<k>     torn mode: bytes transferred before the failure
+      bytes=<k>     torn mode: bytes transferred before the failure;
+                    corrupt mode: bytes flipped (default 1)
       secs=<f>      stall mode: sleep duration
 
 Examples::
@@ -98,7 +106,7 @@ _OPS = (
     "list",
     "any",
 )
-_KINDS = ("transient", "fail", "torn", "stall", "kill")
+_KINDS = ("transient", "fail", "torn", "stall", "kill", "corrupt")
 
 # Plugin surface the wrapper deliberately proxies WITHOUT an injection
 # point: non-data-plane housekeeping where a fault proves nothing about
@@ -252,6 +260,10 @@ def parse_fault_spec(spec: str) -> FaultPlan:
             raise FaultSpecError(
                 f"kind=torn applies to write/append ops, not {rule.op!r}"
             )
+        if rule.kind == "corrupt" and rule.op not in ("read", "any"):
+            raise FaultSpecError(
+                f"kind=corrupt applies to read ops, not {rule.op!r}"
+            )
         plan.rules.append(rule)
     return plan
 
@@ -347,7 +359,9 @@ class FaultyStoragePlugin(StoragePlugin):
             raise InjectedTransientFault(f"injected transient {op} fault: {path}")
         if act.kind == "fail":
             raise InjectedFault(f"injected {op} failure: {path}")
-        return act  # torn: the caller transfers partial bytes then fails
+        # torn: the caller transfers partial bytes then fails.
+        # corrupt: the caller flips bytes in the completed read's buffer.
+        return act
 
     async def _retrying(self, run, label: str):
         return await retry_transient(
@@ -380,14 +394,35 @@ class FaultyStoragePlugin(StoragePlugin):
 
     async def read(self, read_io: ReadIO) -> None:
         async def run() -> None:
-            await self._guard("read", read_io.path)
+            act = await self._guard("read", read_io.path)
             # A retried read must not append to a buffer a failed attempt
             # already partially filled.
             read_io.buf.seek(0)
             read_io.buf.truncate(0)
             await self.inner.read(read_io)
+            if act is not None and act.kind == "corrupt":
+                self._corrupt_buffer(read_io, act.rule)
 
         await self._retrying(run, "faults")
+
+    def _corrupt_buffer(self, read_io: ReadIO, rule: FaultRule) -> None:
+        """``kind=corrupt``: flip ``rule.bytes`` bytes (default 1) of the
+        completed read at seeded offsets. The read still SUCCEEDS — silent
+        bit rot, which only digest verification can catch."""
+        buf = read_io.buf.getbuffer()
+        try:
+            if buf.nbytes == 0:
+                return
+            flips = max(1, rule.bytes)
+            for _ in range(flips):
+                buf[self._rng.randrange(buf.nbytes)] ^= 0xFF
+        finally:
+            buf.release()
+        logger.warning(
+            "FAULT corrupt %d byte(s) on read %s",
+            max(1, rule.bytes),
+            read_io.path,
+        )
 
     async def delete(self, path: str) -> None:
         async def run() -> None:

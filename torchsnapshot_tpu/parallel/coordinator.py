@@ -91,6 +91,14 @@ class Coordinator:
         collectives themselves."""
         self._last_barrier_gen = self._generation
 
+    def defer_delete(self, key: str) -> None:
+        """Register a RAW store key this rank posted outside the collective
+        namespace (e.g. broadcast-restore payload keys) for the same
+        deferred GC the collectives get: deleted best-effort once a later
+        full-world barrier proves every rank has finished reading it.
+        Main-thread only."""
+        self._posted.append((self._generation, key))
+
     # -- collectives --------------------------------------------------------
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         if self._world_size == 1:

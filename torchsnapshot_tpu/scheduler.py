@@ -51,9 +51,24 @@ import psutil
 
 from . import d2h, ledger, telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from .storage_plugins.cloud_retry import (
+    CollectiveProgress,
+    is_transient_os_error,
+    retry_transient,
+)
 from .utils import knobs
 
 logger = logging.getLogger(__name__)
+
+
+class ReadVerificationError(RuntimeError):
+    """A fetched object's bytes did not match the snapshot's recorded
+    digest TWICE — the original fetch and one verified re-fetch (with any
+    read-cache entry for the path quarantined in between). Persistent
+    corruption at the origin, not a transient flake; the restore aborts
+    rather than scatter bad bytes into live state. Raised only under
+    ``TORCHSNAPSHOT_TPU_VERIFY_READS=all`` (cache hits carry their own
+    default-on verification inside the cache plugin)."""
 
 
 # ---------------------------------------------------------------------------
@@ -1325,17 +1340,60 @@ def sync_execute_write_reqs(
     )
 
 
+def _read_digest_record(digests: Optional[Dict[str, object]], path: str):
+    """The sidecar digest for ``path`` in ``[crc32, size, sha256|None]``
+    form, or None when unknown / legacy-int format (no recorded size — a
+    full-object read can't even be recognized, let alone verified)."""
+    if not digests:
+        return None
+    rec = digests.get(path)
+    if isinstance(rec, list) and len(rec) == 3 and isinstance(rec[1], int):
+        return rec
+    return None
+
+
+def _verify_mismatch(mv: memoryview, want: list) -> Optional[str]:
+    """Compare fetched bytes against a sidecar record; returns a mismatch
+    description or None. Runs on an executor thread — both hashes release
+    the GIL for large buffers."""
+    crc_want, size_want, sha_want = want
+    if mv.nbytes != size_want:
+        return f"size {mv.nbytes} != recorded {size_want}"
+    if sha_want:
+        got = hashlib.sha256(mv).hexdigest()
+        if got != sha_want:
+            return f"sha256 {got} != recorded {sha_want}"
+    elif isinstance(crc_want, int):
+        got = zlib.crc32(mv)
+        if got != crc_want:
+            return f"crc32 {got} != recorded {crc_want}"
+    return None
+
+
 async def execute_read_reqs(
     read_reqs: List[ReadReq],
     storage: StoragePlugin,
     memory_budget_bytes: int,
     rank: int,
     pools: Optional[PipelinePools] = None,
+    digests: Optional[Dict[str, object]] = None,
 ) -> Dict[str, float]:
     """Drive the read pipeline to completion. Returns this pipeline's
     accounting — ``{"bytes_read", "wall_s", "requests"}`` — so restore
     callers can aggregate a restore-side record (bench regression gate,
-    persisted artifacts) without a telemetry session."""
+    persisted artifacts) without a telemetry session.
+
+    Fault tolerance: every request retries transient local OSErrors
+    (stale NFS handles, timeouts — the same classification the fs plugin
+    uses) through the shared ``cloud_retry`` machinery under one
+    collective-progress window for the whole pipeline, on top of whatever
+    retrying the plugin stack does internally. With ``digests`` (the
+    snapshot's parsed checksum sidecars) and
+    ``TORCHSNAPSHOT_TPU_VERIFY_READS=all``, every full-object fetch is
+    verified against its recorded digest; a mismatch quarantines any
+    read-cache entry for the path and re-fetches ONCE, and a second
+    mismatch raises :class:`ReadVerificationError` — the restore aborts
+    instead of consuming silently corrupt bytes."""
     begin_ts = time.monotonic()
     budget = _Budget(memory_budget_bytes, owner=f"read@rank{rank}")
     pending: Deque[ReadReq] = deque(
@@ -1352,10 +1410,66 @@ async def execute_read_reqs(
     executor = pools.consuming_executor()
     reporter = _ProgressReporter(rank, "read")
     tm = telemetry.get_active()
+    # One window for the pipeline: any request starting or succeeding is
+    # collective progress, so a transient storm retries while the backend
+    # still moves bytes for peers and gives up ~window after a total stall.
+    read_progress = CollectiveProgress()
+    verify_reads = knobs.is_origin_read_verify_enabled() and bool(digests)
+    quarantine_cache = None
+    if verify_reads:
+        from .storage_plugins.cache import find_read_cache
+
+        quarantine_cache = find_read_cache(storage)
+
+    async def fetch(req: ReadReq) -> ReadIO:
+        read_io = ReadIO(path=req.path, byte_range=req.byte_range)
+
+        async def attempt() -> None:
+            # A retried read must not append to a partially-filled buffer.
+            read_io.buf.seek(0)
+            read_io.buf.truncate(0)
+            await storage.read(read_io)
+
+        await retry_transient(
+            attempt, is_transient_os_error, read_progress, "read_pipeline"
+        )
+        return read_io
 
     async def read_one(req: ReadReq) -> object:
-        read_io = ReadIO(path=req.path, byte_range=req.byte_range)
-        await storage.read(read_io)
+        read_io = await fetch(req)
+        want = _read_digest_record(digests, req.path) if verify_reads else None
+        full_object = want is not None and (
+            req.byte_range is None
+            or (req.byte_range[0] == 0 and req.byte_range[1] == want[1])
+        )
+        if full_object:
+            loop = asyncio.get_running_loop()
+            problem = await loop.run_in_executor(
+                executor, _verify_mismatch, read_io.buf.getbuffer(), want
+            )
+            if problem is not None:
+                telemetry.counter_add("scheduler.read_verify_failures")
+                logger.warning(
+                    "read of %s failed digest verification (%s); "
+                    "quarantining cache entries and re-fetching once",
+                    req.path,
+                    problem,
+                )
+                if quarantine_cache is not None:
+                    await loop.run_in_executor(
+                        executor, quarantine_cache.quarantine_path, req.path
+                    )
+                read_io = await fetch(req)
+                problem = await loop.run_in_executor(
+                    executor, _verify_mismatch, read_io.buf.getbuffer(), want
+                )
+                if problem is not None:
+                    telemetry.counter_add("scheduler.read_verify_failures")
+                    raise ReadVerificationError(
+                        f"read of {req.path} failed digest verification "
+                        f"twice ({problem}); persistent corruption at the "
+                        "source — aborting instead of restoring bad bytes"
+                    )
         return read_io.buf.getbuffer()
 
     def dispatch_reads() -> None:
@@ -1384,7 +1498,15 @@ async def execute_read_reqs(
             for task in done:
                 if task in io_tasks:
                     req, cost, t0 = io_tasks.pop(task)
-                    buf = task.result()
+                    try:
+                        buf = task.result()
+                    except BaseException:
+                        # Already popped, so the abort sweep below can't
+                        # see this task: credit its reservation here or the
+                        # debit leaks (found by the budget ledger under the
+                        # restore chaos matrix).
+                        budget.credit(cost)
+                        raise
                     nbytes = memoryview(buf).nbytes
                     bytes_read += nbytes
                     if tm is not None:
@@ -1402,7 +1524,12 @@ async def execute_read_reqs(
                     ] = (cost, time.monotonic(), req.path)
                 else:
                     cost, t0, path = consume_tasks.pop(task)
-                    task.result()
+                    try:
+                        task.result()
+                    finally:
+                        # Credited whether the consume landed or failed —
+                        # popped above, so no other path can.
+                        budget.credit(cost)
                     if tm is not None:
                         tm.add_span(
                             "scheduler.consume",
@@ -1411,7 +1538,6 @@ async def execute_read_reqs(
                             time.monotonic() - t0,
                             {"path": path, "rank": rank},
                         )
-                    budget.credit(cost)
             dispatch_reads()
             reporter.maybe_report(
                 {
@@ -1471,9 +1597,15 @@ def sync_execute_read_reqs(
     rank: int,
     event_loop: asyncio.AbstractEventLoop,
     pools: Optional[PipelinePools] = None,
+    digests: Optional[Dict[str, object]] = None,
 ) -> Dict[str, float]:
     return event_loop.run_until_complete(
         execute_read_reqs(
-            read_reqs, storage, memory_budget_bytes, rank, pools=pools
+            read_reqs,
+            storage,
+            memory_budget_bytes,
+            rank,
+            pools=pools,
+            digests=digests,
         )
     )

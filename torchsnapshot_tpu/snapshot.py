@@ -32,7 +32,9 @@ TPU-first differences:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import fnmatch
+import hashlib
 import logging
 import os
 import threading
@@ -222,25 +224,29 @@ def _persist_op_artifact(
 
 
 class CheckpointAbortedError(RuntimeError):
-    """A take failed mid-flight and the checkpoint was aborted — cleanly.
+    """A take OR restore failed mid-flight and was aborted — cleanly.
 
-    Raised on EVERY rank (the failing one and its peers, via the commit
-    barrier's error fan-out) within the barrier timeout, so no rank ever
-    hangs on a dead or failing peer. Structured attribution:
+    Raised on EVERY rank (the failing one and its peers, via the commit /
+    post-load barrier's error fan-out) within the barrier timeout, so no
+    rank ever hangs on a dead or failing peer. Structured attribution:
 
-    - ``rank``: the rank whose failure aborted the checkpoint (``None``
+    - ``rank``: the rank whose failure aborted the operation (``None``
       when unattributable — e.g. a peer died without reporting and the
       barrier timed out);
-    - ``phase``: what that rank was doing (``"write"`` — staging + storage
-      drain, ``"commit"`` — the metadata barrier);
+    - ``phase``: what that rank was doing (takes: ``"write"`` — staging +
+      storage drain, ``"commit"`` — the metadata barrier; restores:
+      ``"restore.plan"`` / ``"restore.read"`` / ``"restore.barrier"``);
     - ``detail``: the underlying error's text.
 
-    Invariants that hold when this is raised: ``.snapshot_metadata`` was
+    Invariants that hold when a TAKE aborts: ``.snapshot_metadata`` was
     never written (the snapshot is invisible to readers; a previously
     committed snapshot at another path is untouched), the scheduler's
     memory budget has been fully credited back, and the pipeline pools are
     shut down. Debris (temp files, data objects of the torn take) may
-    remain — ``Snapshot.gc`` reclaims it.
+    remain — ``Snapshot.gc`` reclaims it. When a RESTORE aborts, the
+    snapshot itself is untouched (the read path writes nothing) and the
+    budget/pool invariants hold identically; live restore targets may be
+    partially loaded and must be re-restored before use.
 
     Subclasses RuntimeError: existing callers that catch RuntimeError from
     ``take()``/``PendingSnapshot.wait()`` keep working.
@@ -882,7 +888,15 @@ class Snapshot:
         names one of its ancestors. Statefuls receive a partially-populated
         state dict for the filtered-out leaves; their ``load_state_dict``
         must tolerate that (flax/optax dicts do). SPMD: every rank must
-        pass the same ``include``."""
+        pass the same ``include``.
+
+        Failure semantics mirror ``take``: any mid-restore failure —
+        transient storms past the retry window, permanent storage faults,
+        verification failures, a dead peer — surfaces as a structured
+        :class:`CheckpointAbortedError` naming the failing rank and phase
+        on EVERY rank within the barrier timeout. The snapshot itself is
+        read-only here and stays untouched; live state may be partially
+        loaded (restore targets must be re-restored before use)."""
         self._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
@@ -908,13 +922,33 @@ class Snapshot:
         # One pool set for every per-stateful read pipeline of this restore
         # (instead of a fresh ThreadPoolExecutor per stateful).
         pools = PipelinePools()
+        # Post-load rendezvous WITH error fan-out (the take path's
+        # LinearBarrier, on the read side too): a rank failing mid-restore
+        # unblocks and fails every peer within the barrier timeout —
+        # structured CheckpointAbortedError everywhere, never a peer
+        # deadlocked waiting on a dead reader.
+        barrier = None
+        if coord.get_world_size() > 1:
+            Snapshot._commit_seq += 1
+            barrier = LinearBarrier(
+                store=coord.store,
+                barrier_id=f"restore/{Snapshot._commit_seq}/{self.path}",
+                rank=rank,
+                world_size=coord.get_world_size(),
+            )
+        phase = "restore.plan"
         try:
             with telemetry.span("restore.read_metadata", cat="restore"):
                 metadata = self._read_metadata(storage, event_loop)
-            # Content-addressed read-through cache: hand it the snapshot's
-            # dedup digests so data-object reads become digest-keyed
-            # (shared across snapshots, verifiable on hit).
-            self._attach_cache_digests(storage, metadata, event_loop)
+            # The snapshot's parsed checksum sidecars, read once per
+            # restore: the read-through cache keys data objects by them,
+            # and the read pipeline / broadcast phase verify fetched bytes
+            # against them (TORCHSNAPSHOT_TPU_VERIFY_READS).
+            digest_index = self._load_digest_index(
+                storage, metadata, event_loop
+            )
+            self._attach_cache_digests(storage, digest_index)
+            phase = "restore.read"
             manifest = get_manifest_for_rank(metadata, rank)
             # One-pass prefix index: bucket entries by their FIRST path
             # segment so per-key planning below is O(bucket), not
@@ -960,6 +994,7 @@ class Snapshot:
                             include=include,
                             bcast_enabled=bcast_enabled,
                             coord=coord,
+                            digests=digest_index,
                         )
                         if stats:
                             read_totals["bytes_read"] += stats.get(
@@ -987,10 +1022,32 @@ class Snapshot:
             # Single post-load barrier: no rank observes restore() as
             # complete (and e.g. deletes/overwrites the snapshot, or
             # reports readiness) while a peer is still reading storage.
-            coord.barrier()
+            # LinearBarrier (not coord.barrier): a failing or dead peer
+            # fails this rank promptly with attribution instead of a bare
+            # timeout.
+            phase = "restore.barrier"
+            if barrier is not None:
+                barrier.arrive()
+                barrier.depart()
+                # Full-world rendezvous: the coordinator may collect
+                # collective keys (incl. broadcast-restore payloads)
+                # posted before it.
+                coord.note_external_barrier()
             LAST_RESTORE_STATS.update(read_totals)
             LAST_RESTORE_STATS["wall_s"] = time.monotonic() - restore_t0
             LAST_RESTORE_STATS["bcast"] = dict(bcast_mod.LAST_RESTORE_BCAST)
+        except BaseException as e:
+            aborted = _abort_exception(self.path, barrier, rank, phase, e)
+            if aborted is e:
+                raise
+            if getattr(e, "_tss_app_hook_error", False):
+                # An application load hook raised (marked in
+                # _load_stateful): peers were just released with
+                # attribution via the barrier report above, but the caller
+                # gets the original error type — a missing pytree leaf is
+                # a KeyError, not a checkpoint abort.
+                raise
+            raise aborted from e
         finally:
             pools.shutdown()
             storage.sync_close(event_loop)
@@ -1009,6 +1066,7 @@ class Snapshot:
         include: Optional[List[str]] = None,
         bcast_enabled: bool = False,
         coord: Optional[Coordinator] = None,
+        digests: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, float]:
         # Per-read cap = the whole process budget: a single object/shard
         # larger than the budget would otherwise be admitted whole through
@@ -1164,6 +1222,7 @@ class Snapshot:
                 coord,
                 event_loop,
                 executor=pools.consuming_executor() if pools else None,
+                digests=digests,
             )
 
         if knobs.is_batching_enabled():
@@ -1180,6 +1239,7 @@ class Snapshot:
             rank=get_coordinator(self._coordinator).get_rank(),
             event_loop=event_loop,
             pools=pools,
+            digests=digests,
         )
         # Overlap on: a successful pipeline consumed every read, so every
         # countdown fired and finalized its entry inline; nothing remains.
@@ -1198,7 +1258,16 @@ class Snapshot:
         else:
             full_manifest: Manifest = dict(container_manifest)
             state_dict = inflate(full_manifest, loaded, prefix=key)
-        stateful.load_state_dict(state_dict)
+        try:
+            stateful.load_state_dict(state_dict)
+        except Exception as e:
+            # The application's own load hook raised: a programming error
+            # in app state (shape drift, missing leaf), not a checkpoint
+            # fault. Mark it so restore() releases waiting peers but
+            # propagates the ORIGINAL exception type to the caller.
+            with contextlib.suppress(Exception):
+                e._tss_app_hook_error = True  # type: ignore[attr-defined]
+            raise
         return read_stats or {}
 
     # ----------------------------------------------------------- read_object
@@ -1230,7 +1299,8 @@ class Snapshot:
         storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
         try:
             metadata = self._read_metadata(storage, event_loop)
-            self._attach_cache_digests(storage, metadata, event_loop)
+            digest_index = self._load_digest_index(storage, metadata, event_loop)
+            self._attach_cache_digests(storage, digest_index)
             rank_str, _, logical_path = path.partition("/")
             manifest = get_manifest_for_rank(metadata, int(rank_str))
             entry = manifest.get(logical_path)
@@ -1242,6 +1312,7 @@ class Snapshot:
                     storage,
                     event_loop,
                     memory_budget_bytes,
+                    digests=digest_index,
                 )
             if isinstance(entry, PrimitiveEntry):
                 return entry.get_value()
@@ -1271,6 +1342,7 @@ class Snapshot:
                 or get_process_memory_budget_bytes(None),
                 rank=0,
                 event_loop=event_loop,
+                digests=digest_index,
             )
             if finalize is not None:
                 finalize()
@@ -1287,6 +1359,7 @@ class Snapshot:
         storage: StoragePlugin,
         event_loop: asyncio.AbstractEventLoop,
         memory_budget_bytes: Optional[int],
+        digests: Optional[Dict[str, Any]] = None,
     ) -> Any:
         """Lazy partial read of one manifest subtree: plan only the entries
         under ``logical_path``, coalesce their byte ranges through the read
@@ -1338,6 +1411,7 @@ class Snapshot:
             or get_process_memory_budget_bytes(None),
             rank=0,
             event_loop=event_loop,
+            digests=digests,
         )
         for finalize in finalizers:
             finalize()
@@ -1349,40 +1423,60 @@ class Snapshot:
         }
         return inflate(containers, loaded, prefix=logical_path)
 
-    def _attach_cache_digests(
+    def _load_digest_index(
         self,
         storage: StoragePlugin,
         metadata: SnapshotMetadata,
         event_loop: asyncio.AbstractEventLoop,
+    ) -> Optional[Dict[str, Any]]:
+        """The snapshot's merged checksum-sidecar map (``{path: [crc32,
+        size, sha256 | None]}``), read once per restore/read_object when
+        anything will consume it — the read-through cache (digest keying +
+        hit verification) or the read pipeline / broadcast phase
+        (``TORCHSNAPSHOT_TPU_VERIFY_READS``). None when nothing needs it or
+        the sidecars are unreadable (fail-open: readers degrade to
+        unverified, path-keyed behavior — a missing sidecar must never fail
+        a restore that checksums-off takes produced legitimately)."""
+        wants_digests = bool(knobs.get_read_cache_dir()) or (
+            knobs.get_verify_reads_mode() != "off"
+        )
+        if not wants_digests:
+            return None
+        try:
+            merged, _, _ = _read_checksum_sidecars(
+                storage, metadata.world_size, event_loop
+            )
+        except Exception:  # noqa: BLE001 - degrade, never fail the restore
+            logger.warning(
+                "could not read checksum sidecars; restore reads proceed "
+                "unverified and the read cache stays path-keyed",
+                exc_info=True,
+            )
+            return None
+        return merged or None
+
+    def _attach_cache_digests(
+        self,
+        storage: StoragePlugin,
+        digest_index: Optional[Dict[str, Any]],
     ) -> None:
         """When a read-through cache wraps this plugin stack, hand it the
         snapshot's ``{path: (size, sha256)}`` dedup digests (from the
         checksum sidecars) so data-object reads become content-addressed.
-        Fail-open: a sidecar hiccup just leaves those reads path-keyed."""
-        if not knobs.get_read_cache_dir():
+        Fail-open: without an index those reads just stay path-keyed."""
+        if not digest_index or not knobs.get_read_cache_dir():
             return
         from .storage_plugins.cache import find_read_cache
 
         cache = find_read_cache(storage)
         if cache is None:
             return
-        try:
-            merged, _, _ = _read_checksum_sidecars(
-                storage, metadata.world_size, event_loop
-            )
-        except Exception:  # noqa: BLE001 - cache stays path-keyed
-            logger.warning(
-                "could not read checksum sidecars for the read cache; "
-                "reads stay path-keyed",
-                exc_info=True,
-            )
-            return
         # [crc32, size, sha256 | None] per object: a sha makes the cache
         # entry content-addressed; a sha-less record (dedup digests off at
         # take time) still enables size+crc validation of path-keyed hits.
         index = {
             p: (v[1], v[2], v[0])
-            for p, v in merged.items()
+            for p, v in digest_index.items()
             if isinstance(v, list) and len(v) == 3
         }
         if index:
@@ -1507,6 +1601,357 @@ class Snapshot:
         finally:
             storage.sync_close(event_loop)
             event_loop.close()
+
+    # ------------------------------------------------------------------ scrub
+    def scrub(self, repair: bool = False) -> Dict[str, Any]:
+        """Deep integrity audit — and with ``repair=True``, self-healing —
+        of one committed snapshot.
+
+        Streams every storage object the manifest references through the
+        same budgeted, concurrency-capped read discipline restores use and
+        validates each against the checksum sidecars (size, then sha256
+        when recorded, else crc32) and every framed payload's ``.ftab``
+        frame table (parseable, frame sizes summing to the payload
+        length). Where ``verify()`` is the quick crc audit, scrub is the
+        full bit-rot sweep a serving fleet runs on a schedule.
+
+        Returns a structured per-entry report::
+
+            {"entries": {path: {"status": ..., "detail": ...}},
+             "objects": N, "bytes": N, "problems": N,
+             "corrupt": N, "repaired": N, "quarantined": N, "clean": bool}
+
+        Statuses: ``ok``, ``corrupt`` (bytes exist but don't match the
+        recorded digest), ``missing``, ``unreadable`` (non-absence read
+        failure — possibly transient), ``unverified`` (no readable sidecar
+        covers the object), ``ftab-mismatch``, and under ``repair=True``
+        ``repaired`` / ``quarantined``.
+
+        ``repair=True``: a corrupt or missing object whose exact content
+        survives elsewhere in the snapshot — an alternate rank's copy of
+        the same replicated value, or any object with identical (size,
+        sha256) in the sidecar index (incremental chains dedup by exactly
+        this identity) — is rewritten from that clean copy and
+        re-verified. Unrepairable corrupt objects are **quarantined**:
+        their bytes are moved aside to ``<path>.quarantined`` (so a later
+        restore fails fast with ``missing`` instead of silently consuming
+        rot; ``Snapshot.gc`` reclaims quarantined files as unreferenced
+        debris) and any read-cache entries for the path are purged.
+
+        Single-rank API: no collectives; any operator host can run it.
+        """
+        event_loop = asyncio.new_event_loop()
+        storage = url_to_storage_plugin_in_event_loop(self.path, event_loop)
+        try:
+            with telemetry.span("scrub.scan", cat="scrub", path=self.path):
+                return self._scrub_impl(storage, event_loop, repair)
+        finally:
+            storage.sync_close(event_loop)
+            event_loop.close()
+
+    def _scrub_impl(
+        self,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        repair: bool,
+    ) -> Dict[str, Any]:
+        import zlib as _zlib
+
+        metadata = self._read_metadata(storage, event_loop)
+        expected, _found, unreadable_sidecars = _read_checksum_sidecars(
+            storage, metadata.world_size, event_loop
+        )
+        locations = sorted(_manifest_storage_locations(metadata.manifest))
+        framed = _framed_locations(metadata.manifest)
+        entries: Dict[str, Dict[str, str]] = {}
+        sizes: Dict[str, int] = {}  # actual bytes read per path
+        bytes_scanned = 0
+        # Content index for repair: (size, sha256) -> clean source paths.
+        # Populated as objects VERIFY, so a repair source is always bytes
+        # this scrub has itself validated.
+        clean_by_content: Dict[Tuple[int, str], List[str]] = {}
+
+        def record(path: str, status: str, detail: str = "") -> None:
+            entries[path] = {"status": status, "detail": detail}
+
+        def digest_of(path: str):
+            rec = expected.get(path)
+            if isinstance(rec, list) and len(rec) == 3:
+                return rec
+            if isinstance(rec, int):  # legacy bare-crc sidecars
+                return [rec, None, None]
+            return None
+
+        async def scan_all() -> None:
+            # Same memory discipline as verify(): IO-concurrency cap plus a
+            # byte budget, so scrubbing 512 MB shards can't OOM a small
+            # operator VM.
+            sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
+            budget_total = get_process_memory_budget_bytes(None)
+            avail = budget_total
+            cond = asyncio.Condition()
+
+            async def scan_one(path: str) -> None:
+                nonlocal avail, bytes_scanned
+                want = digest_of(path)
+                cost = (
+                    want[1]
+                    if want is not None and isinstance(want[1], int)
+                    else budget_total // 8
+                )
+                cost = min(cost, budget_total)
+                async with cond:
+                    while avail < cost:
+                        await cond.wait()
+                    avail -= cost
+                try:
+                    async with sem:
+                        read_io = ReadIO(path=path)
+                        try:
+                            await storage.read(read_io)
+                        except FileNotFoundError:
+                            record(path, "missing")
+                            return
+                        except Exception as e:  # noqa: BLE001 - reported
+                            record(path, "unreadable", repr(e))
+                            return
+                        data = read_io.buf.getbuffer()
+                        sizes[path] = data.nbytes
+                        bytes_scanned += data.nbytes
+                        if want is None:
+                            record(
+                                path,
+                                "unverified",
+                                _uncovered_problem(path, unreadable_sidecars),
+                            )
+                            return
+                        crc_want, size_want, sha_want = want
+                        if size_want is not None and data.nbytes != size_want:
+                            record(
+                                path,
+                                "corrupt",
+                                f"size {data.nbytes} != recorded {size_want}",
+                            )
+                            return
+                        if sha_want:
+                            got = hashlib.sha256(data).hexdigest()
+                            if got != sha_want:
+                                record(
+                                    path,
+                                    "corrupt",
+                                    f"sha256 {got} != recorded {sha_want}",
+                                )
+                                return
+                        got_crc = _zlib.crc32(data)
+                        if isinstance(crc_want, int) and got_crc != crc_want:
+                            record(
+                                path,
+                                "corrupt",
+                                f"crc32 {got_crc} != recorded {crc_want}",
+                            )
+                            return
+                        record(path, "ok")
+                        if sha_want and size_want is not None:
+                            clean_by_content.setdefault(
+                                (size_want, sha_want), []
+                            ).append(path)
+                finally:
+                    async with cond:
+                        avail += cost
+                        cond.notify_all()
+
+            await asyncio.gather(*(scan_one(p) for p in locations))
+
+        event_loop.run_until_complete(scan_all())
+
+        # Frame-table validation: every framed payload's .ftab must parse
+        # and its frame sizes must sum to the payload's actual length —
+        # a rotten table silently breaks budgeted sub-reads and slab-member
+        # reads even when the payload bytes are pristine.
+        event_loop.run_until_complete(
+            self._scrub_ftabs(storage, framed, sizes, record)
+        )
+
+        # Sidecar files that exist but could not be read/parsed: their own
+        # problem class, same attribution verify() gives.
+        for r, err in sorted(unreadable_sidecars.items()):
+            record(
+                f"{CHECKSUM_FILE_PREFIX}{r}", "unreadable",
+                f"sidecar unreadable ({err})",
+            )
+
+        repaired = quarantined = 0
+        if repair:
+            repaired, quarantined = event_loop.run_until_complete(
+                self._scrub_repair(
+                    storage, entries, digest_of, clean_by_content
+                )
+            )
+
+        corrupt = sum(
+            1 for e in entries.values() if e["status"] == "corrupt"
+        )
+        problems = sum(
+            1 for e in entries.values() if e["status"] not in ("ok", "repaired")
+        )
+        telemetry.counter_add("scrub.objects", len(locations))
+        telemetry.counter_add("scrub.bytes", bytes_scanned)
+        if corrupt:
+            telemetry.counter_add("scrub.corrupt", corrupt)
+        if repaired:
+            telemetry.counter_add("scrub.repaired", repaired)
+        if quarantined:
+            telemetry.counter_add("scrub.quarantined", quarantined)
+        return {
+            "entries": entries,
+            "objects": len(locations),
+            "bytes": bytes_scanned,
+            "problems": problems,
+            "corrupt": corrupt,
+            "repaired": repaired,
+            "quarantined": quarantined,
+            "clean": problems == 0,
+        }
+
+    async def _scrub_ftabs(
+        self,
+        storage: StoragePlugin,
+        framed: Set[str],
+        sizes: Dict[str, int],
+        record: Callable[..., None],
+    ) -> None:
+        import json as _json
+
+        from .io_preparers.array import FRAME_TABLE_SUFFIX
+
+        sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
+
+        async def check_one(loc: str) -> None:
+            ftab_path = loc + FRAME_TABLE_SUFFIX
+            async with sem:
+                read_io = ReadIO(path=ftab_path)
+                try:
+                    await storage.read(read_io)
+                except FileNotFoundError:
+                    record(ftab_path, "missing", f"frame table of {loc}")
+                    return
+                except Exception as e:  # noqa: BLE001 - reported
+                    record(ftab_path, "unreadable", repr(e))
+                    return
+            try:
+                parsed = _json.loads(read_io.buf.getvalue().decode())
+                frame_sizes = [int(s) for s in parsed["sizes"]]
+                if parsed.get("member_framed") and len(frame_sizes) != len(
+                    parsed["raw_sizes"]
+                ):
+                    raise ValueError(
+                        f"{len(frame_sizes)} frames vs "
+                        f"{len(parsed['raw_sizes'])} raw sizes"
+                    )
+            except Exception as e:  # noqa: BLE001 - a rotten table
+                record(ftab_path, "ftab-mismatch", f"unparseable: {e!r}")
+                return
+            payload_size = sizes.get(loc)
+            if payload_size is not None and sum(frame_sizes) != payload_size:
+                record(
+                    ftab_path,
+                    "ftab-mismatch",
+                    f"frames sum to {sum(frame_sizes)} but payload is "
+                    f"{payload_size} bytes",
+                )
+            else:
+                record(ftab_path, "ok")
+
+        await asyncio.gather(*(check_one(loc) for loc in sorted(framed)))
+
+    async def _scrub_repair(
+        self,
+        storage: StoragePlugin,
+        entries: Dict[str, Dict[str, str]],
+        digest_of: Callable[[str], Optional[list]],
+        clean_by_content: Dict[Tuple[int, str], List[str]],
+    ) -> Tuple[int, int]:
+        """Repair pass: rewrite corrupt/missing objects from a verified
+        clean copy with identical (size, sha256); quarantine corrupt
+        objects with no such copy. crc-only sidecars can't prove a content
+        match, so their objects are never repaired — only quarantined.
+        Returns (repaired, quarantined)."""
+        from .storage_plugins.cache import find_read_cache
+
+        cache = find_read_cache(storage)
+        repaired = quarantined = 0
+        targets = [
+            p
+            for p, e in entries.items()
+            if e["status"] in ("corrupt", "missing")
+            and digest_of(p) is not None
+        ]
+        for path in sorted(targets):
+            status = entries[path]["status"]
+            _crc_want, size_want, sha_want = digest_of(path)
+            sources = []
+            if sha_want and size_want is not None:
+                sources = [
+                    s
+                    for s in clean_by_content.get((size_want, sha_want), [])
+                    if s != path
+                ]
+            healed = False
+            for src in sources:
+                read_io = ReadIO(path=src)
+                try:
+                    await storage.read(read_io)
+                    data = read_io.buf.getvalue()
+                    if (
+                        len(data) != size_want
+                        or hashlib.sha256(data).hexdigest() != sha_want
+                    ):
+                        continue  # source rotted since the scan pass
+                    await storage.write(WriteIO(path=path, buf=data))
+                except Exception:  # noqa: BLE001 - try the next source
+                    logger.warning(
+                        "scrub repair of %s from %s failed", path, src,
+                        exc_info=True,
+                    )
+                    continue
+                prior = entries[path]["detail"] or entries[path]["status"]
+                entries[path] = {
+                    "status": "repaired",
+                    "detail": f"rewritten from {src} (was: {prior})",
+                }
+                repaired += 1
+                healed = True
+                break
+            if healed:
+                if cache is not None:
+                    cache.quarantine_path(path)  # stale entries, if any
+                continue
+            if status != "corrupt":
+                continue  # missing + no copy: nothing to quarantine
+            # Unrepairable corrupt object: move it aside so no restore can
+            # silently consume it — fail-fast "missing" beats silent rot.
+            try:
+                read_io = ReadIO(path=path)
+                await storage.read(read_io)
+                await storage.write(
+                    WriteIO(path=f"{path}.quarantined", buf=read_io.buf.getvalue())
+                )
+                await storage.delete(path)
+            except Exception:  # noqa: BLE001 - report, don't abort the scrub
+                logger.warning(
+                    "could not quarantine corrupt object %s", path,
+                    exc_info=True,
+                )
+                continue
+            if cache is not None:
+                cache.quarantine_path(path)
+            entries[path] = {
+                "status": "quarantined",
+                "detail": f"moved to {path}.quarantined "
+                f"({entries[path]['detail']})",
+            }
+            quarantined += 1
+        return repaired, quarantined
 
     # -------------------------------------------------------------------- gc
     @classmethod
@@ -1882,6 +2327,31 @@ def _uncovered_problem(location: str, unreadable: Dict[int, str]) -> str:
             f"rank(s) {ranks} was unreadable and may cover this object)"
         )
     return "unverified (no checksum recorded)"
+
+
+def _framed_locations(manifest: Manifest) -> Set[str]:
+    """Storage locations that carry a ``.ftab`` frame-table side object:
+    framed compressed payloads (``frame_bytes``) and member-framed slabs
+    (any member with a ``raw_range``). Scrub validates these tables — a
+    rotten table breaks budgeted sub-reads and slab-member reads even when
+    the payload bytes are pristine."""
+
+    def has_table(sub) -> bool:
+        return bool(getattr(sub, "frame_bytes", None)) or (
+            getattr(sub, "raw_range", None) is not None
+        )
+
+    out: Set[str] = set()
+    for entry in manifest.values():
+        if getattr(entry, "location", None) and has_table(entry):
+            out.add(entry.location)
+        for chunk in getattr(entry, "chunks", None) or []:
+            if has_table(chunk.tensor):
+                out.add(chunk.tensor.location)
+        for shard in getattr(entry, "shards", None) or []:
+            if has_table(shard.tensor):
+                out.add(shard.tensor.location)
+    return out
 
 
 def _manifest_storage_locations(manifest: Manifest) -> Set[str]:

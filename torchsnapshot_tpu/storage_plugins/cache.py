@@ -128,6 +128,12 @@ class CachedStoragePlugin(StoragePlugin):
         # In-flight populate dedup: concurrent readers of one cache key on
         # one event loop share a single origin fetch.
         self._inflight: Dict[str, asyncio.Future] = {}
+        # Entries eviction must not touch: mid-populate (between the tmp
+        # write and the post-rename accounting) or with an in-flight reader
+        # (between open and the verified serve). Refcounted under _lock —
+        # a tight byte budget can otherwise evict a just-renamed entry out
+        # from under the reader that is validating it.
+        self._pinned: Dict[str, int] = {}
 
     # -- capability flags proxy the origin ----------------------------------
     @property
@@ -175,6 +181,18 @@ class CachedStoragePlugin(StoragePlugin):
             return self._digest_entry_path(digest[1]), digest
         return self._path_entry_path(path), digest
 
+    def _pin(self, entry: str) -> None:
+        with self._lock:
+            self._pinned[entry] = self._pinned.get(entry, 0) + 1
+
+    def _unpin(self, entry: str) -> None:
+        with self._lock:
+            n = self._pinned.get(entry, 0) - 1
+            if n <= 0:
+                self._pinned.pop(entry, None)
+            else:
+                self._pinned[entry] = n
+
     def _read_entry(
         self,
         entry: str,
@@ -184,7 +202,21 @@ class CachedStoragePlugin(StoragePlugin):
         """Read one cache entry, validating it against the sidecar digest
         when one is known (size always; sha256 — or crc32 for sha-less
         sidecars — under the verify knob). Returns None on miss or
-        corruption (the corrupt entry is unlinked)."""
+        corruption (the corrupt entry is unlinked). The entry is pinned
+        against eviction for the duration — a concurrent populate's LRU
+        pass never unlinks the bytes mid-verified-read."""
+        self._pin(entry)
+        try:
+            return self._read_entry_pinned(entry, expect, verify)
+        finally:
+            self._unpin(entry)
+
+    def _read_entry_pinned(
+        self,
+        entry: str,
+        expect: Optional[Tuple[int, Optional[str], Optional[int]]],
+        verify: bool,
+    ) -> Optional[bytes]:
         try:
             with open(entry, "rb") as f:
                 data = f.read()
@@ -220,23 +252,30 @@ class CachedStoragePlugin(StoragePlugin):
 
     def _write_entry(self, entry: str, data: bytes) -> None:
         """Atomic populate-then-rename; a concurrent reader sees the full
-        entry or none. Failures propagate to the fail-open caller."""
+        entry or none. Failures propagate to the fail-open caller. The
+        entry stays pinned from before the rename until its own eviction
+        pass below completes, so a concurrent populate's LRU scan can never
+        evict the just-renamed bytes before a reader sees them."""
         tmp_dir = os.path.join(self.cache_dir, _TMP_DIR)
         os.makedirs(tmp_dir, exist_ok=True)
         os.makedirs(os.path.dirname(entry), exist_ok=True)
         tmp = os.path.join(tmp_dir, f"{uuid.uuid4().hex}.tmp")
+        self._pin(entry)
         try:
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, entry)
-        except BaseException:
-            with contextlib.suppress(OSError):
-                os.remove(tmp)
-            raise
-        with self._lock:
-            if self._total_bytes is not None:
-                self._total_bytes += len(data)
-        self._maybe_evict()
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, entry)
+            except BaseException:
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+                raise
+            with self._lock:
+                if self._total_bytes is not None:
+                    self._total_bytes += len(data)
+            self._maybe_evict()
+        finally:
+            self._unpin(entry)
 
     def _scan(self) -> List[Tuple[str, int, float]]:
         """All cache entries as (abs path, size, mtime) — the local-store
@@ -258,7 +297,10 @@ class CachedStoragePlugin(StoragePlugin):
         """Evict least-recently-used entries until the store fits the byte
         budget. Runs after each populate, on the executor thread that
         populated; the scan re-derives ground truth so concurrent
-        populators never double-count."""
+        populators never double-count. Pinned entries (mid-populate, or
+        with an in-flight reader) are never evicted — they stay counted
+        toward the total, so the store may transiently exceed the budget
+        by the pinned bytes rather than tear a concurrent read."""
         with self._lock:
             total = self._total_bytes
         if total is None or total > self._max_bytes:
@@ -270,6 +312,11 @@ class CachedStoragePlugin(StoragePlugin):
                 for p, sz, _ in sorted(entries, key=lambda e: e[2]):
                     if total <= self._max_bytes:
                         break
+                    # Re-checked per entry (not a snapshot before the loop)
+                    # so a reader pinning mid-pass is still protected.
+                    with self._lock:
+                        if p in self._pinned:
+                            continue
                     with contextlib.suppress(OSError):
                         os.remove(p)
                         total -= sz
@@ -284,6 +331,41 @@ class CachedStoragePlugin(StoragePlugin):
     def _invalidate_path(self, path: str) -> None:
         with contextlib.suppress(OSError):
             os.remove(self._path_entry_path(path))
+
+    def quarantine_path(self, path: str) -> int:
+        """Remove every local entry that could serve ``path`` — the
+        digest-keyed content entry (when the digest index knows one) AND
+        the path-keyed entry. Called by the read pipeline when a fetched
+        object fails digest verification: whatever the cache holds for the
+        path is suspect and must never be served twice; the next read
+        misses and re-populates from origin. Blocking (unlinks); callers on
+        an event loop run it on an executor. Returns entries removed."""
+        with self._lock:
+            digest = self._digests.get(path)
+        targets = {self._path_entry_path(path)}
+        if digest is not None and digest[1]:
+            targets.add(self._digest_entry_path(digest[1]))
+        removed = 0
+        for entry in targets:
+            try:
+                size = os.path.getsize(entry)
+                os.remove(entry)
+            except OSError:
+                continue
+            removed += 1
+            with self._lock:
+                if self._total_bytes is not None:
+                    self._total_bytes -= size
+        if removed:
+            telemetry.counter_add("cache.quarantined", removed)
+            logger.warning(
+                "quarantined %d cache entr%s for %s after a failed "
+                "read verification",
+                removed,
+                "y" if removed == 1 else "ies",
+                path,
+            )
+        return removed
 
     # -- read path -----------------------------------------------------------
     async def read(self, read_io: ReadIO) -> None:

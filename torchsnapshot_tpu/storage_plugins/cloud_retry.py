@@ -8,6 +8,7 @@ neither plugin reaches into the other's private names.
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 import random
 import time
@@ -20,6 +21,31 @@ logger = logging.getLogger(__name__)
 BASE_BACKOFF_S = 0.5
 MAX_BACKOFF_S = 8.0
 PROGRESS_WINDOW_S = 120.0
+
+# Local errno values that are plausibly transient on NETWORK filesystems
+# (NFS/SMB-mounted checkpoint dirs): a stale handle after a server failover,
+# a timed-out round-trip, a briefly-busy inode. On genuinely local disks
+# these are rare enough that a couple of retries cost nothing. Permanent
+# conditions (ENOSPC, EACCES, EROFS, ENOENT...) are deliberately absent —
+# retrying those just delays a real error. Shared between the fs plugin and
+# the scheduler's read pipeline so the two layers can never disagree on the
+# classification.
+TRANSIENT_OS_ERRNOS = frozenset(
+    e
+    for e in (
+        errno.ESTALE,
+        errno.ETIMEDOUT,
+        errno.EAGAIN,
+        errno.EBUSY,
+        errno.EINTR,
+        getattr(errno, "EREMOTEIO", None),
+    )
+    if e is not None
+)
+
+
+def is_transient_os_error(e: Exception) -> bool:
+    return isinstance(e, OSError) and e.errno in TRANSIENT_OS_ERRNOS
 
 
 class CollectiveProgress:

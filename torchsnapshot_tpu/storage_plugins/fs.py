@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
-import errno
 import os
 import threading
 import time
@@ -37,32 +36,21 @@ except ImportError:  # pragma: no cover - environment-dependent
 from .. import native, telemetry
 from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
 from ..utils import knobs
-from .cloud_retry import CollectiveProgress, retry_transient
+from .cloud_retry import (
+    TRANSIENT_OS_ERRNOS,
+    CollectiveProgress,
+    is_transient_os_error,
+    retry_transient,
+)
 
 _DIRECT_ALIGN = 4096  # matches the native engine's kAlign
 
-# Local errno values that are plausibly transient on NETWORK filesystems
-# (NFS/SMB-mounted checkpoint dirs): a stale handle after a server failover,
-# a timed-out round-trip, a briefly-busy inode. On genuinely local disks
-# these are rare enough that a couple of retries cost nothing. Permanent
-# conditions (ENOSPC, EACCES, EROFS, ENOENT...) are deliberately absent —
-# retrying those just delays a real error.
-_TRANSIENT_ERRNOS = frozenset(
-    e
-    for e in (
-        errno.ESTALE,
-        errno.ETIMEDOUT,
-        errno.EAGAIN,
-        errno.EBUSY,
-        errno.EINTR,
-        getattr(errno, "EREMOTEIO", None),
-    )
-    if e is not None
-)
-
-
-def _is_transient_oserror(e: Exception) -> bool:
-    return isinstance(e, OSError) and e.errno in _TRANSIENT_ERRNOS
+# The transient-errno classification lives in cloud_retry
+# (TRANSIENT_OS_ERRNOS) so the scheduler's read-pipeline retry and this
+# plugin can never disagree; these aliases keep the plugin's historical
+# names importable.
+_TRANSIENT_ERRNOS = TRANSIENT_OS_ERRNOS
+_is_transient_oserror = is_transient_os_error
 
 
 class _FSWriteStream(StorageWriteStream):

@@ -765,7 +765,11 @@ def is_read_cache_verify_enabled() -> bool:
     """Verify digest-keyed cache hits against their recorded sha256 before
     serving (default on). A corrupt local entry then falls back to the
     origin and is re-populated instead of silently serving bad bytes; the
-    cost is one hash pass per hit (~GB/s, GIL released)."""
+    cost is one hash pass per hit (~GB/s, GIL released).
+    ``TORCHSNAPSHOT_TPU_VERIFY_READS=0`` is the master off switch: it
+    disables cache-hit verification too."""
+    if get_verify_reads_mode() == "off":
+        return False
     return os.environ.get(_ENV_READ_CACHE_VERIFY, "1") not in (
         "0",
         "false",
@@ -783,6 +787,41 @@ def override_read_cache_bytes(value: int):
 
 def override_read_cache_verify(enabled: bool):
     return _override_env(_ENV_READ_CACHE_VERIFY, "1" if enabled else "0")
+
+
+_ENV_VERIFY_READS = "TORCHSNAPSHOT_TPU_VERIFY_READS"
+
+
+def get_verify_reads_mode() -> str:
+    """Read-side digest-verification mode: ``auto`` | ``all`` | ``off``.
+
+    - ``auto`` (default): cache hits are verified against their sidecar
+      digest before being served (subject to
+      ``TORCHSNAPSHOT_TPU_READ_CACHE_VERIFY``); origin reads are trusted —
+      backends carry their own transport checksums.
+    - ``all`` (``1``): the read pipeline additionally verifies EVERY
+      full-object fetch (origin or cache) against the snapshot's checksum
+      sidecars, with one verified re-fetch on mismatch before a structured
+      abort — the bit-rot shield for serving fleets.
+    - ``off`` (``0``): no read-side verification anywhere, including cache
+      hits."""
+    val = os.environ.get(_ENV_VERIFY_READS, "auto").lower()
+    if val in ("", "auto"):
+        return "auto"
+    if val in ("0", "false", "off"):
+        return "off"
+    return "all"
+
+
+def is_origin_read_verify_enabled() -> bool:
+    """Whether the scheduler's read pipeline verifies fetched objects
+    against the sidecar digests (the ``all`` mode of
+    ``TORCHSNAPSHOT_TPU_VERIFY_READS``)."""
+    return get_verify_reads_mode() == "all"
+
+
+def override_verify_reads(mode: str):
+    return _override_env(_ENV_VERIFY_READS, mode)
 
 
 _ENV_BCAST_RESTORE = "TORCHSNAPSHOT_TPU_BCAST_RESTORE"
@@ -825,6 +864,50 @@ def override_broadcast_restore(enabled: bool):
 
 def override_broadcast_max_bytes(value: int):
     return _override_env(_ENV_BCAST_MAX_BYTES, str(value))
+
+
+_ENV_BCAST_READER_DEADLINE = "TORCHSNAPSHOT_TPU_BCAST_READER_DEADLINE_S"
+_ENV_BCAST_REELECT_MAX = "TORCHSNAPSHOT_TPU_BCAST_REELECT_MAX"
+
+_DEFAULT_BCAST_READER_DEADLINE_S = 60.0
+_DEFAULT_BCAST_REELECT_MAX = 1
+
+
+def get_bcast_reader_deadline_s() -> float:
+    """How long a broadcast-restore peer waits for the elected reader's
+    payload (or error marker) before declaring the reader dead and electing
+    the next rank in the sha1 order (default 60 s). Each re-election attempt
+    gets a fresh deadline; a reader that posts late is still consumed (its
+    payload key is generation- and attempt-fenced, so a slow reader can
+    never corrupt a later attempt)."""
+    try:
+        return max(
+            0.05,
+            float(
+                os.environ.get(
+                    _ENV_BCAST_READER_DEADLINE,
+                    _DEFAULT_BCAST_READER_DEADLINE_S,
+                )
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_BCAST_READER_DEADLINE_S
+
+
+def get_bcast_reelect_max() -> int:
+    """Max reader re-elections per broadcast object before a peer stops
+    waiting and falls back to a DIRECT origin read (default 1). The
+    fallback means broadcast mode can never be less available than direct
+    mode: a peer that can reach the origin always makes progress."""
+    return max(0, _get_int(_ENV_BCAST_REELECT_MAX, _DEFAULT_BCAST_REELECT_MAX))
+
+
+def override_bcast_reader_deadline_s(value: float):
+    return _override_env(_ENV_BCAST_READER_DEADLINE, str(value))
+
+
+def override_bcast_reelect_max(value: int):
+    return _override_env(_ENV_BCAST_REELECT_MAX, str(value))
 
 
 _ENV_READ_MERGE_GAP = "TORCHSNAPSHOT_TPU_READ_MERGE_GAP_BYTES"
