@@ -73,15 +73,37 @@ def regression_gate(
     drain_s: float,
     drain_vs_link: float,
     restore_s: float = 0.0,
+    stage_hash_s: float = 0.0,
 ) -> dict:
     """Fail-soft regression gate: compare this run's drain wall,
-    drain_vs_link, AND restore wall against the BEST prior BENCH_r0*.json
-    taken on the same workload (matched by detail.size_gb). Never raises
-    and never aborts the bench — the link itself drifts run to run — but a
-    >10% drain-wall or restore-wall regression or a >0.05 drain_vs_link
-    drop is logged loudly and recorded in the emitted JSON so the
-    trajectory can't regress silently. Priors that predate restore timing
+    drain_vs_link, restore wall, AND drain hash time (``stage_hash_s`` —
+    the PR-10 headline: chunk-parallel hashing must keep it off the
+    critical path) against the BEST prior BENCH_r0*.json taken on the same
+    workload (matched by detail.size_gb). Never raises and never aborts the
+    bench — the link itself drifts run to run, and the round artifact must
+    ALWAYS be written — but a >10% drain/restore-wall regression, a >0.05
+    drain_vs_link drop, or a >25%+0.25s hash-time regression is logged
+    loudly and recorded in the emitted JSON so the trajectory can't regress
+    silently. An EMPTY prior trajectory (first round on a workload, or the
+    artifacts were moved) is itself reported loudly as ``no_prior`` rather
+    than silently skipping the comparison. Priors that predate a metric
     simply don't constrain it."""
+    try:
+        return _regression_gate_impl(
+            size_gb, drain_s, drain_vs_link, restore_s, stage_hash_s
+        )
+    except Exception as e:  # pragma: no cover - the gate is fail-soft
+        log(f"WARNING: bench regression gate errored ({e!r}); skipping")
+        return {"status": "error", "priors": 0, "note": repr(e)}
+
+
+def _regression_gate_impl(
+    size_gb: float,
+    drain_s: float,
+    drain_vs_link: float,
+    restore_s: float,
+    stage_hash_s: float,
+) -> dict:
     import glob
 
     priors = []
@@ -98,16 +120,29 @@ def regression_gate(
                     float(det["background_drain_s"]),
                     float(det.get("drain_vs_link", 0.0)),
                     float((det.get("restore") or {}).get("wall_s", 0.0)),
+                    float(
+                        (det.get("stage_breakdown_s") or {}).get(
+                            "stage_hash_s", 0.0
+                        )
+                    ),
                 )
             )
         except Exception:
             continue  # unreadable/alien artifact: skip, never fail
     if not priors:
-        return {"status": "no_prior", "priors": 0}
+        note = (
+            f"no prior BENCH_r0*.json matches this workload "
+            f"({size_gb:.2f} GB): nothing to compare against — the round "
+            "artifact is still written and seeds the trajectory"
+        )
+        log(f"WARNING: bench regression gate: {note}")
+        return {"status": "no_prior", "priors": 0, "note": note}
     best_drain_s = min(p[1] for p in priors)
     best_vs_link = max(p[2] for p in priors)
     restore_priors = [p[3] for p in priors if p[3] > 0]
     best_restore_s = min(restore_priors) if restore_priors else 0.0
+    hash_priors = [p[4] for p in priors if p[4] > 0]
+    best_hash_s = min(hash_priors) if hash_priors else 0.0
     problems = []
     if drain_s > best_drain_s * 1.10:
         problems.append(
@@ -124,6 +159,19 @@ def regression_gate(
             f"restore wall {restore_s:.2f}s is >10% over the best prior "
             f"{best_restore_s:.2f}s"
         )
+    # Hash wall is small and noisy relative to the drain: gate on a
+    # relative AND absolute regression so jitter on a near-zero value
+    # can't cry wolf.
+    if (
+        stage_hash_s > 0
+        and best_hash_s > 0
+        and stage_hash_s > best_hash_s * 1.25 + 0.25
+    ):
+        problems.append(
+            f"drain stage_hash_s {stage_hash_s:.2f}s is >25% over the best "
+            f"prior {best_hash_s:.2f}s — hashing is creeping back onto the "
+            "drain's critical path"
+        )
     for p in problems:
         log(f"WARNING: bench regression gate: {p}")
     return {
@@ -132,6 +180,7 @@ def regression_gate(
         "best_prior_drain_s": round(best_drain_s, 2),
         "best_prior_drain_vs_link": round(best_vs_link, 2),
         "best_prior_restore_s": round(best_restore_s, 2),
+        "best_prior_stage_hash_s": round(best_hash_s, 2),
         "problems": problems,
     }
 
@@ -542,9 +591,17 @@ def main() -> None:
         log(f"full restore: {restore_record}")
 
         # ---- fail-soft regression gate vs the best prior round on this
-        # workload (same size_gb): drain wall, drain_vs_link, and restore
-        # wall must not silently regress the way rounds 2→5 did.
-        gate = regression_gate(round(gb, 2), drain_s, drain_vs_link, restore_s)
+        # workload (same size_gb): drain wall, drain_vs_link, restore wall,
+        # and drain hash time must not silently regress the way rounds
+        # 2→5 did. An empty trajectory reports no_prior loudly; the round
+        # artifact is written either way.
+        gate = regression_gate(
+            round(gb, 2),
+            drain_s,
+            drain_vs_link,
+            restore_s,
+            stage_hash_s=stage_breakdown.get("stage_hash_s", 0.0),
+        )
         log(f"regression gate: {gate}")
 
         print(

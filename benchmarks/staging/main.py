@@ -96,7 +96,12 @@ def build_host_state(total_mb: int, arrays: int, seed: int = 0):
 
 
 def run_config(
-    arrs, stream: bool, checksums: bool, dedup: bool
+    arrs,
+    stream: bool,
+    checksums: bool,
+    dedup: bool,
+    hash_grain: int = None,
+    hash_workers: int = None,
 ) -> dict:
     storage = NullStoragePlugin()
     reqs = []
@@ -112,9 +117,17 @@ def run_config(
         await pending.complete()
         return pending
 
+    import contextlib
+
+    overrides = contextlib.ExitStack()
+    if hash_grain is not None:
+        overrides.enter_context(knobs.override_hash_chunk_bytes(hash_grain))
+    if hash_workers is not None:
+        overrides.enter_context(knobs.override_hash_workers(hash_workers))
     loop = asyncio.new_event_loop()
     try:
-        with knobs.override_stream_writes(stream), \
+        with overrides, \
+                knobs.override_stream_writes(stream), \
                 knobs.override_checksums(checksums), \
                 knobs.override_dedup_digests(dedup):
             t0 = time.perf_counter()
@@ -141,10 +154,21 @@ def main() -> None:
     total_gb = sum(a.nbytes for a in arrs) / 1e9
     log(f"staging micro-bench: {total_gb:.2f} GB across {arrays} host arrays")
 
+    # Warmup: absorb one-time costs (thread-pool spawn, hashing-engine
+    # operator caches, lazy imports) on a tiny slice so the matrix's FIRST
+    # cell isn't charged ~0.2s the others never pay.
+    run_config([a[:64] for a in arrs[:1]], stream=True, checksums=True,
+               dedup=True)
+
     # The ablation matrix: diffing rows bisects which staging feature a
-    # regression lives in. "full" is the production default path.
+    # regression lives in. "full" is the production default path (chunked
+    # v2 tree hashing); "serial_hash" pins the v1 serial fold (grain 0) so
+    # chunked-vs-serial hashing stays directly comparable every run.
     matrix = {
         "full": dict(stream=True, checksums=True, dedup=True),
+        "serial_hash": dict(
+            stream=True, checksums=True, dedup=True, hash_grain=0
+        ),
         "no_dedup_sha": dict(stream=True, checksums=True, dedup=False),
         "no_digests": dict(stream=True, checksums=False, dedup=False),
         "no_stream": dict(stream=False, checksums=True, dedup=True),
@@ -155,6 +179,42 @@ def main() -> None:
         log(f"  {name}: {results[name]}")
 
     full, bare = results["full"], results["no_digests"]
+
+    def hash_cost(cell: dict) -> float:
+        # Wall paid over the digest-free baseline: the cell's hashing bill.
+        return round(max(0.0, cell["wall_s"] - bare["wall_s"]), 4)
+
+    # Optional hash-grain x hash-worker sweep (serial v1 vs chunked v2 at
+    # several grains, across pool widths): the tuning map for
+    # TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES / _HASH_WORKERS. The full sweep is
+    # slow-lane material (pre_commit.yaml); the fast smoke skips it.
+    hash_sweep = None
+    if os.environ.get("STAGING_BENCH_HASH_SWEEP"):
+        default_grain = knobs.get_hash_chunk_bytes()
+        default_workers = knobs.get_hash_workers()
+        grains = {
+            "serial": 0,
+            f"g{default_grain // (1024 * 1024)}m": default_grain,
+            f"g{max(1, default_grain // 4) // (1024 * 1024)}m": max(
+                1024 * 1024, default_grain // 4
+            ),
+        }
+        workers = sorted({1, default_workers, 2 * default_workers})
+        hash_sweep = {}
+        for gname, grain in grains.items():
+            for w in workers:
+                cell = run_config(
+                    arrs,
+                    stream=True,
+                    checksums=True,
+                    dedup=True,
+                    hash_grain=grain,
+                    hash_workers=w,
+                )
+                cell["hash_cost_s"] = hash_cost(cell)
+                hash_sweep[f"{gname}_w{w}"] = cell
+                log(f"  hash sweep {gname}_w{w}: {cell}")
+
     print(
         json.dumps(
             {
@@ -166,10 +226,11 @@ def main() -> None:
                     "arrays": arrays,
                     "configs": results,
                     # The hash satellite's measurable delta: staging rate
-                    # with vs without the digest pipeline.
-                    "hash_cost_s": round(
-                        max(0.0, full["wall_s"] - bare["wall_s"]), 4
-                    ),
+                    # with vs without the digest pipeline — chunked (the
+                    # default) and the serial v1 fold side by side.
+                    "hash_cost_s": hash_cost(full),
+                    "serial_hash_cost_s": hash_cost(results["serial_hash"]),
+                    "hash_sweep": hash_sweep,
                     "env": {"knobs": knobs.env_fingerprint()},
                 },
             }

@@ -9,7 +9,6 @@ decomposition in drain stats and persisted telemetry artifacts.
 """
 
 import asyncio
-import hashlib
 import json
 import zlib
 
@@ -147,14 +146,18 @@ def test_zero_copy_raw_bit_exact_vs_whole_buffer(dtype, contiguous) -> None:
     streamed = take(stream=True)
     assert whole.keys() == streamed.keys()
     assert whole["obj"] == streamed["obj"]
-    # Sidecar digests (crc32, size, sha256) match between the paths and
-    # match an independent whole-object hash.
+    # Sidecar digests match between the paths and match an independent
+    # whole-object recompute: identical v2 tree records (combined crc32
+    # bit-identical to the serial fold, root over the per-chunk sha256s).
+    from torchsnapshot_tpu import hashing
+
     wc, sc = (json.loads(side[".checksums.0"]) for side in (whole, streamed))
     assert wc == sc
-    crc, size, sha = wc["obj"]
-    assert crc == zlib.crc32(whole["obj"])
-    assert size == len(whole["obj"])
-    assert sha == hashlib.sha256(whole["obj"]).hexdigest()
+    rec = wc["obj"]
+    assert hashing.record_crc(rec) == zlib.crc32(whole["obj"])
+    assert hashing.record_size(rec) == len(whole["obj"])
+    grain = rec["grain"] if hashing.is_v2_record(rec) else 0
+    assert rec == hashing.digest_of_bytes(whole["obj"], grain)
 
 
 def test_zero_copy_framed_compressed_bit_exact_with_ftab() -> None:

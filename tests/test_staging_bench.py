@@ -12,15 +12,17 @@ import sys
 import pytest
 
 
-def _run_bench(mb: int, arrays: int) -> dict:
+def _run_bench(mb: int, arrays: int, extra_env: dict = None) -> dict:
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "JAX_PLATFORMS": "cpu",
+        "STAGING_BENCH_MB": str(mb),
+        "STAGING_BENCH_ARRAYS": str(arrays),
+    }
+    env.update(extra_env or {})
     out = subprocess.run(
         [sys.executable, "benchmarks/staging/main.py"],
-        env={
-            "PATH": "/usr/bin:/bin:/usr/local/bin",
-            "JAX_PLATFORMS": "cpu",
-            "STAGING_BENCH_MB": str(mb),
-            "STAGING_BENCH_ARRAYS": str(arrays),
-        },
+        env=env,
         capture_output=True,
         text=True,
         timeout=300,
@@ -36,7 +38,9 @@ def test_staging_bench_smoke_tiny() -> None:
     assert rec["metric"] == "staging_overhead_gbps"
     det = rec["detail"]
     assert det["size_gb"] > 0
-    for name in ("full", "no_dedup_sha", "no_digests", "no_stream"):
+    for name in (
+        "full", "serial_hash", "no_dedup_sha", "no_digests", "no_stream"
+    ):
         cfg = det["configs"][name]
         assert cfg["wall_s"] > 0
         assert cfg["gbps"] > 0
@@ -45,6 +49,10 @@ def test_staging_bench_smoke_tiny() -> None:
     # Digest ablation is measurable: the no-digest config never hashes.
     assert det["configs"]["no_digests"]["stage_hash_s"] == 0
     assert det["hash_cost_s"] >= 0
+    # Chunked-v2 vs serial-v1 hashing stays directly comparable every run.
+    assert det["serial_hash_cost_s"] >= 0
+    # The fast smoke skips the grain x worker sweep (slow lane material).
+    assert det["hash_sweep"] is None
 
 
 @pytest.mark.slow
@@ -62,3 +70,21 @@ def test_staging_bench_slow_smoke() -> None:
     # not lost (hash folds may overlap the append stream, so compare
     # against the decomposition's own total).
     assert full["wall_s"] >= full["stage_busy_s"] - 0.5
+
+
+@pytest.mark.slow
+def test_staging_bench_hash_sweep() -> None:
+    """The hash-grain x hash-worker sweep (serial-v1 vs chunked-v2 cells,
+    STAGING_BENCH_HASH_SWEEP=1) reports wall + hash_cost_s per cell at a
+    size where every array streams."""
+    rec = _run_bench(
+        mb=128, arrays=2, extra_env={"STAGING_BENCH_HASH_SWEEP": "1"}
+    )
+    sweep = rec["detail"]["hash_sweep"]
+    assert sweep, "sweep env set but no cells reported"
+    # At least one serial-v1 cell and one chunked-v2 cell per worker width.
+    assert any(name.startswith("serial_w") for name in sweep)
+    assert any(not name.startswith("serial_w") for name in sweep)
+    for name, cell in sweep.items():
+        assert cell["wall_s"] > 0, name
+        assert cell["hash_cost_s"] >= 0, name

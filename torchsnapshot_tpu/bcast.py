@@ -77,7 +77,7 @@ from .manifest import (
 from .scheduler import (
     ReadVerificationError,
     _read_digest_record,
-    _verify_mismatch,
+    _verify_checker,
 )
 from .utils import knobs
 
@@ -251,9 +251,10 @@ class _BcastSession:
         self, key: Tuple[str, Optional[Tuple[int, int]]]
     ) -> bytes:
         """One origin read of ``key``, digest-verified when the sidecars
-        cover it (full-object reads only), with one quarantine + re-fetch
-        on mismatch — a reader must never fan corrupt bytes out to the
-        fleet, and a peer's direct fallback must be as safe as the
+        cover it (full objects whole; ranged reads at chunk granularity
+        when the record carries a v2 chunk grid), with one quarantine +
+        re-fetch on mismatch — a reader must never fan corrupt bytes out
+        to the fleet, and a peer's direct fallback must be as safe as the
         pipeline's reads."""
         loop = asyncio.get_running_loop()
         path, byte_range = key
@@ -265,14 +266,11 @@ class _BcastSession:
 
         data = await fetch_once()
         want = _read_digest_record(self.digests, path) if self.verify else None
-        full_object = want is not None and (
-            byte_range is None
-            or (byte_range[0] == 0 and byte_range[1] == want[1])
-        )
-        if not full_object:
+        checker = _verify_checker(want, byte_range) if want is not None else None
+        if checker is None:
             return data
         problem = await loop.run_in_executor(
-            self.executor, _verify_mismatch, memoryview(data), want
+            self.executor, checker, memoryview(data)
         )
         if problem is None:
             return data
@@ -289,7 +287,7 @@ class _BcastSession:
             )
         data = await fetch_once()
         problem = await loop.run_in_executor(
-            self.executor, _verify_mismatch, memoryview(data), want
+            self.executor, checker, memoryview(data)
         )
         if problem is not None:
             telemetry.counter_add("bcast.verify_failures")

@@ -103,13 +103,17 @@ class StageTimes:
         t1: float,
         path: str = "",
         nbytes: int = 0,
+        span: Optional[str] = None,
     ) -> None:
+        # ``span`` overrides the exported span name while the interval still
+        # joins ``kind``'s sub-stream — parallel chunk hashes export as
+        # ``stage.hash_chunk`` spans but stay inside ``stage_hash_s``.
         with self._lock:
             self._intervals[kind].append((t0, t1))
         tm = self._tm
         if tm is not None:
             tm.add_span(
-                f"stage.{kind}",
+                span or f"stage.{kind}",
                 "stage",
                 t0,
                 t1 - t0,

@@ -54,6 +54,14 @@ Spec grammar (rules separated by ``;``, fields by ``,``)::
       path=<substr> only inject on ops whose path contains this substring
       bytes=<k>     torn mode: bytes transferred before the failure;
                     corrupt mode: bytes flipped (default 1)
+      chunk=<k>     corrupt mode only: flip bytes inside hash chunk k's
+                    extent ([k*grain, (k+1)*grain) of the OBJECT, grain =
+                    TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES) instead of anywhere
+                    in the buffer — the seeded rot chunk-granular
+                    verification (ranged VERIFY_READS, scrub attribution,
+                    per-chunk repair) must detect and localize. Ranged
+                    reads translate the extent into buffer coordinates; a
+                    read not covering the chunk is left intact.
       secs=<f>      stall mode: sleep duration
 
 Examples::
@@ -147,6 +155,7 @@ class FaultRule:
     rank: Optional[int] = None
     path: Optional[str] = None
     bytes: int = 0
+    chunk: Optional[int] = None
     secs: float = 0.0
     injected: int = 0  # how often this rule has fired (mutable state)
 
@@ -185,7 +194,7 @@ class FaultPlan:
     window_s: Optional[float] = None
 
 
-_INT_FIELDS = ("at", "after", "every", "times", "rank", "bytes")
+_INT_FIELDS = ("at", "after", "every", "times", "rank", "bytes", "chunk")
 _FLOAT_FIELDS = ("p", "secs")
 
 
@@ -263,6 +272,10 @@ def parse_fault_spec(spec: str) -> FaultPlan:
         if rule.kind == "corrupt" and rule.op not in ("read", "any"):
             raise FaultSpecError(
                 f"kind=corrupt applies to read ops, not {rule.op!r}"
+            )
+        if rule.chunk is not None and rule.kind != "corrupt":
+            raise FaultSpecError(
+                f"chunk= targets corrupt rules only, not kind={rule.kind!r}"
             )
         plan.rules.append(rule)
     return plan
@@ -407,21 +420,53 @@ class FaultyStoragePlugin(StoragePlugin):
 
     def _corrupt_buffer(self, read_io: ReadIO, rule: FaultRule) -> None:
         """``kind=corrupt``: flip ``rule.bytes`` bytes (default 1) of the
-        completed read at seeded offsets. The read still SUCCEEDS — silent
-        bit rot, which only digest verification can catch."""
+        completed read at seeded offsets — anywhere in the buffer, or
+        confined to hash chunk ``rule.chunk``'s extent when the rule is
+        chunk-targeted. The read still SUCCEEDS — silent bit rot, which
+        only digest verification can catch (and, for chunk-targeted rot,
+        must attribute to exactly that chunk)."""
         buf = read_io.buf.getbuffer()
         try:
             if buf.nbytes == 0:
                 return
+            lo, hi = 0, buf.nbytes
+            if rule.chunk is not None:
+                from .utils import knobs
+
+                grain = knobs.get_hash_chunk_bytes()
+                if grain <= 0:
+                    logger.warning(
+                        "FAULT corrupt chunk=%d ignored: hash chunking is "
+                        "disabled (grain 0)",
+                        rule.chunk,
+                    )
+                    return
+                # Chunk extents are object coordinates; a ranged read's
+                # buffer starts at byte_range[0] of the object.
+                base = read_io.byte_range[0] if read_io.byte_range else 0
+                lo = max(0, rule.chunk * grain - base)
+                hi = min(buf.nbytes, (rule.chunk + 1) * grain - base)
+                if hi <= lo:
+                    logger.warning(
+                        "FAULT corrupt chunk=%d skipped: read %s%s does not "
+                        "cover the chunk's extent",
+                        rule.chunk,
+                        read_io.path,
+                        f" range {read_io.byte_range}"
+                        if read_io.byte_range
+                        else "",
+                    )
+                    return
             flips = max(1, rule.bytes)
             for _ in range(flips):
-                buf[self._rng.randrange(buf.nbytes)] ^= 0xFF
+                buf[lo + self._rng.randrange(hi - lo)] ^= 0xFF
         finally:
             buf.release()
         logger.warning(
-            "FAULT corrupt %d byte(s) on read %s",
+            "FAULT corrupt %d byte(s) on read %s%s",
             max(1, rule.bytes),
             read_io.path,
+            f" (chunk {rule.chunk})" if rule.chunk is not None else "",
         )
 
     async def delete(self, path: str) -> None:

@@ -42,14 +42,13 @@ import logging
 import os
 import socket
 import time
-import zlib
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import d2h, ledger, telemetry
+from . import d2h, hashing, ledger, telemetry
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .storage_plugins.cloud_retry import (
     CollectiveProgress,
@@ -149,22 +148,13 @@ def _stream_stats(
 
 CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
-
-def _digest_buffer(mv: memoryview, want_sha: bool) -> list:
-    """[crc32, size, sha256-hex | None] of one staged buffer. crc feeds
-    Snapshot.verify(); (size, sha256) is the dedup identity for incremental
-    snapshots (collision-resistant, unlike crc). ``want_sha`` is resolved
-    once per pipeline (``knobs.is_dedup_digests_enabled``: auto-gated on
-    CPU headroom, forced on when the take passes ``base=``). sha256 over
-    blake2b: OpenSSL's implementation is ~2x faster per core here and
-    releases the GIL for large buffers, so the hash pool scales on
-    multi-core hosts."""
-    sha = None
-    if want_sha:
-        h = hashlib.sha256()
-        h.update(mv)
-        sha = h.hexdigest()
-    return [zlib.crc32(mv), mv.nbytes, sha]
+# Digesting lives in ``hashing.py``: objects larger than one hash chunk
+# (``TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES``) are hashed chunk-PARALLEL on the
+# hash pool and recorded as v2 tree-digest records (per-chunk sha256s +
+# combined crc32, bit-identical to the serial fold); smaller ones keep the
+# exact v1 ``[crc32, size, sha256|None]`` record. ``want_sha`` is resolved
+# once per pipeline (``knobs.is_dedup_digests_enabled``: auto-gated on CPU
+# headroom, forced on when the take passes ``base=``).
 
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
@@ -236,12 +226,14 @@ class PipelinePools:
         return self._staging
 
     def hash_executor(self) -> ThreadPoolExecutor:
-        # As wide as staging: hashing (~0.9 GB/s/thread for crc+sha256)
-        # must not become the bottleneck of incremental takes, where it
-        # replaces the skipped storage write.
+        # Sized by TORCHSNAPSHOT_TPU_HASH_WORKERS (default: the staging
+        # width): hashing (~1 GB/s/thread for crc+sha256) must not become
+        # the drain's bottleneck now that chunk jobs of ONE object can
+        # occupy every worker, and on incremental takes it replaces the
+        # skipped storage write.
         if self._hash is None:
             self._hash = ThreadPoolExecutor(
-                max_workers=knobs.get_staging_threads(),
+                max_workers=knobs.get_hash_workers(),
                 thread_name_prefix="tss-hash",
             )
         return self._hash
@@ -379,6 +371,13 @@ class _WritePipeline:
         self._want_sha = knobs.is_dedup_digests_enabled(
             has_base=base_loader is not None
         )
+        # The chunked-hashing grain, resolved once for the same reason
+        # (0 = the serial v1 fold; objects <= one chunk keep v1 records).
+        self._hash_grain = knobs.get_hash_chunk_bytes()
+        # Set at base resolution: True when the base's sidecars carry v1
+        # whole-object identities, so new objects must compute the whole
+        # sha256 too (the compat shim) or dedup would spuriously re-upload.
+        self._base_needs_whole_sha = False
         self._base_lock = asyncio.Lock()
         self.base = None
         self.bytes_deduped = 0
@@ -624,11 +623,27 @@ class _WritePipeline:
             admitted_cost = 0
         outstanding = 0  # bytes debited for chunks whose append hasn't landed
         want_digest = knobs.is_checksums_enabled()
-        sha = hashlib.sha256() if (want_digest and self._want_sha) else None
-        crc = 0
         total = 0
         chunks = 0
         loop = asyncio.get_running_loop()
+        hasher = None
+        if want_digest:
+            if self._crc_executor is None:
+                self._crc_executor = self.pools.hash_executor()
+            # Chunk-parallel digesting (hashing.ChunkHasher): appends no
+            # longer wait on the fold — each grain-chunk's crc32+sha256 is
+            # an independent job on the hash pool, crcs recombine to the
+            # bit-identical whole-object crc32, and the sha256 tree root
+            # becomes the object's dedup/cache identity. Grain 0 keeps the
+            # exact serial v1 fold (and its append backpressure).
+            hasher = hashing.make_stream_hasher(
+                self._hash_grain,
+                self._want_sha,
+                loop,
+                self._crc_executor,
+                times=self._staging_ctx.times,
+                path=req.path,
+            )
         queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, inflight))
         _END = object()
         try:
@@ -673,38 +688,23 @@ class _WritePipeline:
             # so the sentinel is only needed on normal completion).
             await queue.put((_END, 0))
 
-        times = self._staging_ctx.times
-
         async def consume() -> None:
-            nonlocal crc, total, outstanding
+            nonlocal total, outstanding
             while True:
                 buf, nbytes = await queue.get()
                 if buf is _END:
                     return
-                if want_digest:
-                    # Fold this chunk into the object's running digest on
-                    # the hash pool (GIL released, never the staging
-                    # thread); sequential per stream, so chunk order — and
-                    # thus the digest — is exact. Folds directly over the
-                    # staged view (no copy); sha256 is skipped entirely
-                    # when dedup digests are off. Timed inside the thunk:
-                    # the ``hash`` sub-stream measures hashing, not queue
-                    # wait.
-                    if self._crc_executor is None:
-                        self._crc_executor = self.pools.hash_executor()
-
-                    def fold(mv=memoryview(buf), c=crc):
-                        t0 = time.monotonic()
-                        if sha is not None:
-                            sha.update(mv)
-                        out = zlib.crc32(mv, c)
-                        times.record(
-                            "hash", t0, time.monotonic(),
-                            path=req.path, nbytes=mv.nbytes,
-                        )
-                        return out
-
-                    crc = await loop.run_in_executor(self._crc_executor, fold)
+                if hasher is not None:
+                    # Hand the chunk's bytes to the hashing engine. With a
+                    # positive grain this only SLICES views and dispatches
+                    # completed grain-chunks as concurrent hash-pool jobs —
+                    # the append below never waits on a fold (it awaits
+                    # only the engine's backpressure semaphore, which
+                    # bounds the hash backlog's retained views). The staged
+                    # buffer stays alive until its chunks are hashed; the
+                    # memoryview keeps it so past the budget credit below,
+                    # bounded by max_inflight x grain.
+                    await hasher.feed(buf)
                 t0 = time.monotonic()
                 await stream.append(buf)
                 self._record_task("io", t0, req.path, nbytes)
@@ -725,6 +725,8 @@ class _WritePipeline:
             for t in (ptask, ctask):
                 t.cancel()
             await asyncio.gather(ptask, ctask, return_exceptions=True)
+            if hasher is not None:
+                hasher.abort()
             try:
                 await stream.abort()
             except Exception:  # noqa: BLE001 - the original failure wins
@@ -748,10 +750,10 @@ class _WritePipeline:
         )
         self.progress.note_request_done()
         telemetry.counter_add("scheduler.stream_chunks", chunks)
-        if want_digest:
-            self.checksums[req.path] = [
-                crc, total, sha.hexdigest() if sha is not None else None
-            ]
+        if hasher is not None:
+            # Gather the chunk digests (most already done — they ran under
+            # the appends) and combine: crc32_combine + tree root.
+            self.checksums[req.path] = await hasher.finalize()
 
     def _timed_hash(self, path: str, nbytes: int, fn):
         """Run one hashing thunk with its interval recorded in the ``hash``
@@ -793,35 +795,71 @@ class _WritePipeline:
                             # dedup against a base object at a DIFFERENT
                             # path — e.g. batched slabs, whose
                             # ``batched/<uuid>`` paths are fresh each take
-                            # even when their bytes are identical.
+                            # even when their bytes are identical. Keys are
+                            # the records' content identities (v1 whole-sha
+                            # AND/OR v2 tree-root — hashing.py owns both),
+                            # so mixed v1-base + v2-delta chains dedup.
                             root, digests = self.base
-                            by_content = {
-                                (v[1], v[2]): k
-                                for k, v in digests.items()
-                                if isinstance(v, list)
-                                and len(v) == 3
-                                and v[2] is not None
-                            }
+                            by_content = {}
+                            for k, v in digests.items():
+                                sz = hashing.record_size(v)
+                                for key in hashing.record_content_keys(v):
+                                    by_content.setdefault((sz, key), k)
                             self.base = (root, digests, by_content)
+                            # A base with v1 whole-object identities needs
+                            # new objects to carry a whole sha256 too (the
+                            # compat shim) or nothing would ever match.
+                            self._base_needs_whole_sha = any(
+                                isinstance(v, list)
+                                for v in digests.values()
+                            )
                         self._base_resolved = True
+            mv = memoryview(buf)
+            grain = self._hash_grain
+            times = self._staging_ctx.times
             if self.base is None:
-                # No incremental base: nothing needs the digest BEFORE the
-                # write, so let the plugin compute the crc inside its own
-                # write loop (the native FS engine hashes chunk-hot in C++
-                # — WriteIO.digest_out) and only hash in Python what the
-                # plugin didn't cover: everything (non-native backends), or
-                # just the sha256 dedup digest.
+                if grain > 0 and mv.nbytes > grain:
+                    # v2 path: chunk-PARALLEL digest on the hash pool,
+                    # overlapping the storage write — neither waits on the
+                    # other, and the hash itself scales with HASH_WORKERS
+                    # instead of serializing one fold per object.
+                    digest_task = asyncio.ensure_future(
+                        hashing.hash_buffer(
+                            mv,
+                            grain,
+                            self._want_sha,
+                            loop,
+                            self._crc_executor,
+                            times=times,
+                            path=path,
+                        )
+                    )
+                    try:
+                        await self.storage.write(WriteIO(path=path, buf=buf))
+                    except BaseException:
+                        digest_task.cancel()
+                        await asyncio.gather(
+                            digest_task, return_exceptions=True
+                        )
+                        raise
+                    self.checksums[path] = await digest_task
+                    return
+                # Small (<= one hash chunk) or serial-mode objects keep the
+                # exact v1 record and the plugin fast path: the native FS
+                # engine hashes chunk-hot in C++ inside its own write loop
+                # (WriteIO.digest_out), and Python covers only what the
+                # plugin didn't — everything (non-native backends), or just
+                # the sha256 dedup digest.
                 write_io = WriteIO(path=path, buf=buf, want_digest=True)
                 await self.storage.write(write_io)
                 digest = write_io.digest_out
-                mv = memoryview(buf)
                 if digest is None:
                     digest = await loop.run_in_executor(
                         self._crc_executor,
                         self._timed_hash(
                             path,
                             mv.nbytes,
-                            lambda: _digest_buffer(mv, self._want_sha),
+                            lambda: hashing.serial_digest(mv, self._want_sha),
                         ),
                     )
                 elif digest[2] is None and self._want_sha:
@@ -841,35 +879,46 @@ class _WritePipeline:
                     ]
                 self.checksums[path] = digest
                 return
-            mv = memoryview(buf)
-            digest = await loop.run_in_executor(
+            # Incremental take: the digest decides link-in vs write, so it
+            # must land BEFORE the write — but it is still chunk-parallel
+            # across the pool (plus the sequential whole-sha compat job
+            # when the base recorded v1 identities).
+            digest = await hashing.hash_buffer(
+                mv,
+                grain,
+                self._want_sha,
+                loop,
                 self._crc_executor,
-                self._timed_hash(
-                    path, mv.nbytes, lambda: _digest_buffer(mv, self._want_sha)
-                ),
+                times=times,
+                path=path,
+                want_whole_sha=self._base_needs_whole_sha,
             )
             self.checksums[path] = digest
-            if digest[2] is not None:
+            my_keys = hashing.record_content_keys(digest)
+            my_size = hashing.record_size(digest)
+            if my_keys:
                 base_root, base_digests, by_content = self.base
                 rec = base_digests.get(path)
                 src_path = None
                 if (
-                    isinstance(rec, list)
-                    and len(rec) == 3
-                    and rec[1] == digest[1]
-                    and rec[2] == digest[2]
+                    rec is not None
+                    and hashing.record_size(rec) == my_size
+                    and set(my_keys) & set(hashing.record_content_keys(rec))
                 ):
                     src_path = path
                 else:
-                    src_path = by_content.get((digest[1], digest[2]))
+                    for key in my_keys:
+                        src_path = by_content.get((my_size, key))
+                        if src_path is not None:
+                            break
                 if src_path is not None:
                     # Byte-identical to a base snapshot object (size +
-                    # sha256 match): hard-link / server-side copy instead
-                    # of rewriting. Any failure (cross-device, base
+                    # content-key match): hard-link / server-side copy
+                    # instead of rewriting. Any failure (cross-device, base
                     # deleted, backend mismatch) falls back to a write.
                     src = os.path.join(base_root, src_path)
                     if await self.storage.link_in(src, path):
-                        self.bytes_deduped += digest[1]
+                        self.bytes_deduped += my_size
                         return
         await self.storage.write(WriteIO(path=path, buf=buf))
 
@@ -1341,32 +1390,37 @@ def sync_execute_write_reqs(
 
 
 def _read_digest_record(digests: Optional[Dict[str, object]], path: str):
-    """The sidecar digest for ``path`` in ``[crc32, size, sha256|None]``
-    form, or None when unknown / legacy-int format (no recorded size — a
-    full-object read can't even be recognized, let alone verified)."""
+    """The sidecar digest record for ``path`` — a v1 ``[crc32, size, sha]``
+    list or a v2 tree-digest dict — or None when unknown / legacy-int
+    format (no recorded size: a full-object read can't even be recognized,
+    let alone verified). Interpretation belongs to ``hashing.py``'s record
+    accessors."""
     if not digests:
         return None
     rec = digests.get(path)
-    if isinstance(rec, list) and len(rec) == 3 and isinstance(rec[1], int):
-        return rec
-    return None
+    if hashing.record_size(rec) is None:
+        return None
+    return rec
 
 
-def _verify_mismatch(mv: memoryview, want: list) -> Optional[str]:
-    """Compare fetched bytes against a sidecar record; returns a mismatch
-    description or None. Runs on an executor thread — both hashes release
-    the GIL for large buffers."""
-    crc_want, size_want, sha_want = want
-    if mv.nbytes != size_want:
-        return f"size {mv.nbytes} != recorded {size_want}"
-    if sha_want:
-        got = hashlib.sha256(mv).hexdigest()
-        if got != sha_want:
-            return f"sha256 {got} != recorded {sha_want}"
-    elif isinstance(crc_want, int):
-        got = zlib.crc32(mv)
-        if got != crc_want:
-            return f"crc32 {got} != recorded {crc_want}"
+def _verify_checker(
+    want, byte_range: Optional[Tuple[int, int]]
+) -> Optional[Callable[[memoryview], Optional[str]]]:
+    """The verification thunk (run on an executor thread) for one fetched
+    request, or None when nothing is verifiable: full-object fetches check
+    the whole record (tree or v1); RANGED fetches of v2 tree records check
+    every chunk fully contained in the range — the capability the chunked
+    sidecar exists for (v1 records can't verify a range at all)."""
+    size = hashing.record_size(want)
+    if byte_range is None or (
+        size is not None and byte_range[0] == 0 and byte_range[1] == size
+    ):
+        return lambda mv, w=want: hashing.verify_buffer(mv, w)
+    begin, end = byte_range
+    if hashing.range_verifiable(want, begin, end):
+        return lambda mv, w=want, b=begin, e=end: hashing.verify_range(
+            mv, w, b, e
+        )
     return None
 
 
@@ -1438,14 +1492,13 @@ async def execute_read_reqs(
     async def read_one(req: ReadReq) -> object:
         read_io = await fetch(req)
         want = _read_digest_record(digests, req.path) if verify_reads else None
-        full_object = want is not None and (
-            req.byte_range is None
-            or (req.byte_range[0] == 0 and req.byte_range[1] == want[1])
+        checker = (
+            _verify_checker(want, req.byte_range) if want is not None else None
         )
-        if full_object:
+        if checker is not None:
             loop = asyncio.get_running_loop()
             problem = await loop.run_in_executor(
-                executor, _verify_mismatch, read_io.buf.getbuffer(), want
+                executor, checker, read_io.buf.getbuffer()
             )
             if problem is not None:
                 telemetry.counter_add("scheduler.read_verify_failures")
@@ -1461,7 +1514,7 @@ async def execute_read_reqs(
                     )
                 read_io = await fetch(req)
                 problem = await loop.run_in_executor(
-                    executor, _verify_mismatch, read_io.buf.getbuffer(), want
+                    executor, checker, read_io.buf.getbuffer()
                 )
                 if problem is not None:
                     telemetry.counter_add("scheduler.read_verify_failures")

@@ -82,7 +82,7 @@ from .scheduler import (
 )
 from .stateful import AppState, Stateful
 from .storage_plugin import url_to_storage_plugin_in_event_loop
-from . import telemetry
+from . import hashing, telemetry
 from .utils import knobs
 from .version import __version__
 
@@ -835,13 +835,15 @@ class Snapshot:
                     base,
                     unreadable,
                 )
-            # Skip sha-less entries (dedup digests were off): an all-None
-            # base then hits the no-digests warning below instead of
-            # loading as a silently useless base.
-            digests: Dict[str, list] = {
+            # Skip entries without a collision-resistant content identity
+            # (dedup digests were off): an identity-less base then hits the
+            # no-digests warning below instead of loading as a silently
+            # useless base. ``hashing.record_content_keys`` owns both
+            # formats — a v1 whole-object sha AND a v2 tree root qualify.
+            digests: Dict[str, Any] = {
                 k: v
                 for k, v in merged.items()
-                if isinstance(v, list) and len(v) == 3 and v[2] is not None
+                if hashing.record_content_keys(v)
             }
             if digests and len(digests) < len(merged):
                 # Mixed coverage: some ranks of the base take recorded shas
@@ -1471,14 +1473,23 @@ class Snapshot:
         cache = find_read_cache(storage)
         if cache is None:
             return
-        # [crc32, size, sha256 | None] per object: a sha makes the cache
-        # entry content-addressed; a sha-less record (dedup digests off at
-        # take time) still enables size+crc validation of path-keyed hits.
-        index = {
-            p: (v[1], v[2], v[0])
-            for p, v in digest_index.items()
-            if isinstance(v, list) and len(v) == 3
-        }
+        # One 4-tuple per object: (size, cache-key, crc, chunk-info). A v1
+        # sha (or v2 tree root + grain) makes the cache entry
+        # content-addressed; a key-less record (dedup digests off at take
+        # time) still enables size+crc validation of path-keyed hits. v2
+        # chunk info lets the cache verify only the chunks a ranged hit
+        # actually serves.
+        index = {}
+        for p, v in digest_index.items():
+            size = hashing.record_size(v)
+            if size is None:
+                continue
+            index[p] = (
+                size,
+                hashing.record_cache_key(v),
+                hashing.record_crc(v),
+                hashing.record_chunk_info(v),
+            )
         if index:
             cache.attach_digest_index(index)
 
@@ -1556,9 +1567,11 @@ class Snapshot:
 
                 async def check_one(path: str, want) -> None:
                     nonlocal avail
-                    # Recorded size when the sidecar has one; a conservative
-                    # slice of the budget for legacy int-format entries.
-                    cost = want[1] if isinstance(want, list) else budget_total // 8
+                    # Recorded size when the sidecar has one (v1 list or v2
+                    # tree record); a conservative slice of the budget for
+                    # legacy int-format entries.
+                    rec_size = hashing.record_size(want)
+                    cost = rec_size if rec_size is not None else budget_total // 8
                     cost = min(cost, budget_total)  # oversize: admit alone
                     async with cond:
                         while avail < cost:
@@ -1580,9 +1593,12 @@ class Snapshot:
                                 return
                             got = _zlib.crc32(read_io.buf.getbuffer())
                             # Sidecar value: bare crc int (pre-digest
-                            # snapshots) or [crc, size, sha256] (current).
-                            want_crc = want if isinstance(want, int) else want[0]
-                            if got != want_crc:
+                            # snapshots), [crc, size, sha256] (v1), or a v2
+                            # tree record — whose combined crc is
+                            # bit-identical to the serial fold, so this
+                            # quick audit needs no per-chunk work.
+                            want_crc = hashing.record_crc(want)
+                            if want_crc is not None and got != want_crc:
                                 problems[path] = (
                                     f"crc mismatch (recorded {want_crc}, "
                                     f"found {got})"
@@ -1666,20 +1682,27 @@ class Snapshot:
         entries: Dict[str, Dict[str, str]] = {}
         sizes: Dict[str, int] = {}  # actual bytes read per path
         bytes_scanned = 0
-        # Content index for repair: (size, sha256) -> clean source paths.
-        # Populated as objects VERIFY, so a repair source is always bytes
-        # this scrub has itself validated.
+        # Content index for repair: (size, content-key) -> clean source
+        # paths, keyed by every identity the record carries (v1 whole-sha
+        # AND/OR v2 tree root). Populated as objects VERIFY, so a repair
+        # source is always bytes this scrub has itself validated.
         clean_by_content: Dict[Tuple[int, str], List[str]] = {}
+        # v2 chunk attribution: path -> corrupt chunk indices, feeding the
+        # repair pass's chunk-extent rewrites.
+        corrupt_chunks: Dict[str, List[int]] = {}
 
         def record(path: str, status: str, detail: str = "") -> None:
             entries[path] = {"status": status, "detail": detail}
 
         def digest_of(path: str):
+            """The raw sidecar record (legacy int, v1 list, or v2 dict) —
+            interpreted everywhere via ``hashing``'s accessors."""
             rec = expected.get(path)
-            if isinstance(rec, list) and len(rec) == 3:
+            if (
+                isinstance(rec, int)
+                or hashing.record_size(rec) is not None
+            ):
                 return rec
-            if isinstance(rec, int):  # legacy bare-crc sidecars
-                return [rec, None, None]
             return None
 
         async def scan_all() -> None:
@@ -1694,11 +1717,8 @@ class Snapshot:
             async def scan_one(path: str) -> None:
                 nonlocal avail, bytes_scanned
                 want = digest_of(path)
-                cost = (
-                    want[1]
-                    if want is not None and isinstance(want[1], int)
-                    else budget_total // 8
-                )
+                rec_size = hashing.record_size(want)
+                cost = rec_size if rec_size is not None else budget_total // 8
                 cost = min(cost, budget_total)
                 async with cond:
                     while avail < cost:
@@ -1725,7 +1745,7 @@ class Snapshot:
                                 _uncovered_problem(path, unreadable_sidecars),
                             )
                             return
-                        crc_want, size_want, sha_want = want
+                        size_want = rec_size
                         if size_want is not None and data.nbytes != size_want:
                             record(
                                 path,
@@ -1733,28 +1753,51 @@ class Snapshot:
                                 f"size {data.nbytes} != recorded {size_want}",
                             )
                             return
-                        if sha_want:
-                            got = hashlib.sha256(data).hexdigest()
-                            if got != sha_want:
+                        info = hashing.record_chunk_info(want)
+                        if info is not None:
+                            # v2 tree record: per-chunk audit attributes
+                            # corruption to the exact chunk(s), and the
+                            # repair pass can rewrite just their extents.
+                            bad = hashing.find_bad_chunks(data, want)
+                            if bad:
+                                grain = info[0]
+                                kind = (
+                                    "sha256" if info[1] is not None else "crc32"
+                                )
+                                corrupt_chunks[path] = bad
                                 record(
                                     path,
                                     "corrupt",
-                                    f"sha256 {got} != recorded {sha_want}",
+                                    f"chunk {kind} mismatch at chunk(s) "
+                                    f"{bad} (grain {grain})",
                                 )
                                 return
-                        got_crc = _zlib.crc32(data)
-                        if isinstance(crc_want, int) and got_crc != crc_want:
-                            record(
-                                path,
-                                "corrupt",
-                                f"crc32 {got_crc} != recorded {crc_want}",
-                            )
-                            return
+                        else:
+                            sha_want = hashing.record_whole_sha(want)
+                            if sha_want:
+                                got = hashlib.sha256(data).hexdigest()
+                                if got != sha_want:
+                                    record(
+                                        path,
+                                        "corrupt",
+                                        f"sha256 {got} != recorded {sha_want}",
+                                    )
+                                    return
+                            crc_want = hashing.record_crc(want)
+                            got_crc = _zlib.crc32(data)
+                            if isinstance(crc_want, int) and got_crc != crc_want:
+                                record(
+                                    path,
+                                    "corrupt",
+                                    f"crc32 {got_crc} != recorded {crc_want}",
+                                )
+                                return
                         record(path, "ok")
-                        if sha_want and size_want is not None:
-                            clean_by_content.setdefault(
-                                (size_want, sha_want), []
-                            ).append(path)
+                        if size_want is not None:
+                            for key in hashing.record_content_keys(want):
+                                clean_by_content.setdefault(
+                                    (size_want, key), []
+                                ).append(path)
                 finally:
                     async with cond:
                         avail += cost
@@ -1784,7 +1827,8 @@ class Snapshot:
         if repair:
             repaired, quarantined = event_loop.run_until_complete(
                 self._scrub_repair(
-                    storage, entries, digest_of, clean_by_content
+                    storage, entries, digest_of, clean_by_content,
+                    corrupt_chunks,
                 )
             )
 
@@ -1870,15 +1914,22 @@ class Snapshot:
         entries: Dict[str, Dict[str, str]],
         digest_of: Callable[[str], Optional[list]],
         clean_by_content: Dict[Tuple[int, str], List[str]],
+        corrupt_chunks: Optional[Dict[str, List[int]]] = None,
     ) -> Tuple[int, int]:
         """Repair pass: rewrite corrupt/missing objects from a verified
-        clean copy with identical (size, sha256); quarantine corrupt
-        objects with no such copy. crc-only sidecars can't prove a content
-        match, so their objects are never repaired — only quarantined.
-        Returns (repaired, quarantined)."""
+        clean copy with an identical content identity (v1 whole-sha or v2
+        tree root at matching size); quarantine corrupt objects with no
+        such copy. When the scan attributed corruption to specific chunks
+        (v2 records), repair fetches only THOSE chunks' extents from the
+        clean source — a single rotten 32 MB chunk of a multi-GB object no
+        longer costs a full-object copy — patches the local bytes, and
+        re-verifies the whole tree before rewriting. crc-only sidecars
+        can't prove a content match, so their objects are never repaired —
+        only quarantined. Returns (repaired, quarantined)."""
         from .storage_plugins.cache import find_read_cache
 
         cache = find_read_cache(storage)
+        corrupt_chunks = corrupt_chunks or {}
         repaired = quarantined = 0
         targets = [
             p
@@ -1888,26 +1939,58 @@ class Snapshot:
         ]
         for path in sorted(targets):
             status = entries[path]["status"]
-            _crc_want, size_want, sha_want = digest_of(path)
-            sources = []
-            if sha_want and size_want is not None:
-                sources = [
-                    s
-                    for s in clean_by_content.get((size_want, sha_want), [])
-                    if s != path
-                ]
+            rec = digest_of(path)
+            size_want = hashing.record_size(rec)
+            keys = hashing.record_content_keys(rec)
+            sources: List[str] = []
+            if keys and size_want is not None:
+                seen: Set[str] = set()
+                for key in keys:
+                    for s in clean_by_content.get((size_want, key), []):
+                        if s != path and s not in seen:
+                            seen.add(s)
+                            sources.append(s)
+            bad = corrupt_chunks.get(path)
+            info = hashing.record_chunk_info(rec)
             healed = False
             for src in sources:
-                read_io = ReadIO(path=src)
                 try:
-                    await storage.read(read_io)
-                    data = read_io.buf.getvalue()
-                    if (
-                        len(data) != size_want
-                        or hashlib.sha256(data).hexdigest() != sha_want
-                    ):
-                        continue  # source rotted since the scan pass
-                    await storage.write(WriteIO(path=path, buf=data))
+                    if bad and info is not None and status == "corrupt":
+                        # Chunk-extent repair: read the object once, fetch
+                        # only the bad chunks' byte ranges from the clean
+                        # source, patch, and re-verify the whole tree.
+                        grain = info[0]
+                        cur = ReadIO(path=path)
+                        await storage.read(cur)
+                        data = bytearray(cur.buf.getvalue())
+                        if len(data) != size_want:
+                            raise ValueError(
+                                f"object is {len(data)} bytes now, "
+                                f"recorded {size_want}"
+                            )
+                        for k in bad:
+                            b, e = k * grain, min((k + 1) * grain, size_want)
+                            rio = ReadIO(path=src, byte_range=(b, e))
+                            await storage.read(rio)
+                            data[b:e] = rio.buf.getvalue()
+                        if hashing.verify_buffer(
+                            memoryview(data), rec
+                        ) is not None:
+                            continue  # source rotted since the scan pass
+                        await storage.write(
+                            WriteIO(path=path, buf=bytes(data))
+                        )
+                        how = f"chunk(s) {bad} patched from {src}"
+                    else:
+                        read_io = ReadIO(path=src)
+                        await storage.read(read_io)
+                        data = read_io.buf.getvalue()
+                        if hashing.verify_buffer(
+                            memoryview(data), rec
+                        ) is not None:
+                            continue  # source rotted since the scan pass
+                        await storage.write(WriteIO(path=path, buf=data))
+                        how = f"rewritten from {src}"
                 except Exception:  # noqa: BLE001 - try the next source
                     logger.warning(
                         "scrub repair of %s from %s failed", path, src,
@@ -1917,7 +2000,7 @@ class Snapshot:
                 prior = entries[path]["detail"] or entries[path]["status"]
                 entries[path] = {
                     "status": "repaired",
-                    "detail": f"rewritten from {src} (was: {prior})",
+                    "detail": f"{how} (was: {prior})",
                 }
                 repaired += 1
                 healed = True

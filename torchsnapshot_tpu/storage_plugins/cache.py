@@ -65,7 +65,7 @@ import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
-from .. import telemetry
+from .. import hashing, telemetry
 from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
 from ..utils import knobs
 
@@ -113,13 +113,17 @@ class CachedStoragePlugin(StoragePlugin):
         self._max_bytes = (
             max_bytes if max_bytes is not None else knobs.get_read_cache_bytes()
         )
-        # path -> (size, sha256-hex | None, crc32 | None): the sidecar
-        # digests of the snapshot(s) being read, attached by
-        # Snapshot.restore/read_object. A sha makes the entry
+        # path -> (size, cache-key | None, crc32 | None, chunk-info | None):
+        # the sidecar digests of the snapshot(s) being read, attached by
+        # Snapshot.restore/read_object. A key (v1 whole-object sha, or a v2
+        # tree root suffixed with its grain) makes the entry
         # content-addressed; without one (DEDUP_DIGESTS off at take time)
         # the entry stays path-keyed but hits are still size+crc-validated.
-        # Paths absent here fall back to unvalidated path-keyed entries.
-        self._digests: Dict[str, Tuple[int, Optional[str], Optional[int]]] = {}
+        # chunk-info (a ``hashing.record_chunk_info`` tuple) switches hit
+        # verification to per-chunk — ranged hits then check only the
+        # chunks they serve. Paths absent here fall back to unvalidated
+        # path-keyed entries.
+        self._digests: Dict[str, Tuple] = {}
         self._executor: Optional[ThreadPoolExecutor] = None
         # Guards the store-size accounting and LRU bookkeeping, which are
         # mutated from executor threads.
@@ -145,16 +149,16 @@ class CachedStoragePlugin(StoragePlugin):
         return bool(getattr(self.inner, "scales_io_with_local_world", False))
 
     # -- digest index --------------------------------------------------------
-    def attach_digest_index(
-        self, index: Dict[str, Tuple[int, Optional[str], Optional[int]]]
-    ) -> None:
-        """Merge ``{path: (size, sha256 | None, crc32 | None)}`` — the
-        parsed checksum sidecars — so reads of those paths become
-        content-addressed (sha present) or at least size+crc-validated.
+    def attach_digest_index(self, index: Dict[str, Tuple]) -> None:
+        """Merge ``{path: (size, key | None, crc32 | None[, chunk-info])}``
+        — the parsed checksum sidecars — so reads of those paths become
+        content-addressed (key present) or at least size+crc-validated.
+        3-tuples (the pre-tree-digest shape) are accepted and normalized.
         Idempotent; callers may attach once per snapshot they read through
         this plugin."""
         with self._lock:
-            self._digests.update(index)
+            for p, v in index.items():
+                self._digests[p] = tuple(v) + (None,) * (4 - len(v))
 
     # -- local store helpers (blocking; run on the executor) -----------------
     def _get_executor(self) -> ThreadPoolExecutor:
@@ -173,9 +177,7 @@ class CachedStoragePlugin(StoragePlugin):
         ).hexdigest()
         return os.path.join(self.cache_dir, _PATH_DIR, key[:2], key)
 
-    def _entry_for(
-        self, path: str
-    ) -> Tuple[str, Optional[Tuple[int, Optional[str], Optional[int]]]]:
+    def _entry_for(self, path: str) -> Tuple[str, Optional[Tuple]]:
         digest = self._digests.get(path)
         if digest is not None and digest[1]:
             return self._digest_entry_path(digest[1]), digest
@@ -196,26 +198,30 @@ class CachedStoragePlugin(StoragePlugin):
     def _read_entry(
         self,
         entry: str,
-        expect: Optional[Tuple[int, Optional[str], Optional[int]]],
+        expect: Optional[Tuple],
         verify: bool,
+        byte_range: Optional[Tuple[int, int]] = None,
     ) -> Optional[bytes]:
         """Read one cache entry, validating it against the sidecar digest
-        when one is known (size always; sha256 — or crc32 for sha-less
-        sidecars — under the verify knob). Returns None on miss or
-        corruption (the corrupt entry is unlinked). The entry is pinned
-        against eviction for the duration — a concurrent populate's LRU
-        pass never unlinks the bytes mid-verified-read."""
+        when one is known (size always; under the verify knob: per-chunk
+        tree digests when the record carries a chunk grid — a RANGED hit
+        then verifies only the chunks it serves — else the v1 whole-object
+        sha256, else crc32). Returns None on miss or corruption (the
+        corrupt entry is unlinked). The entry is pinned against eviction
+        for the duration — a concurrent populate's LRU pass never unlinks
+        the bytes mid-verified-read."""
         self._pin(entry)
         try:
-            return self._read_entry_pinned(entry, expect, verify)
+            return self._read_entry_pinned(entry, expect, verify, byte_range)
         finally:
             self._unpin(entry)
 
     def _read_entry_pinned(
         self,
         entry: str,
-        expect: Optional[Tuple[int, Optional[str], Optional[int]]],
+        expect: Optional[Tuple],
         verify: bool,
+        byte_range: Optional[Tuple[int, int]] = None,
     ) -> Optional[bytes]:
         try:
             with open(entry, "rb") as f:
@@ -226,11 +232,20 @@ class CachedStoragePlugin(StoragePlugin):
             logger.warning("cache entry %s unreadable", entry, exc_info=True)
             return None
         if expect is not None:
-            size, sha, crc = expect
+            size, key, crc = expect[0], expect[1], expect[2]
+            chunks = expect[3] if len(expect) > 3 else None
             ok = len(data) == size
             if ok and verify:
-                if sha:
-                    ok = hashlib.sha256(data).hexdigest() == sha
+                if chunks is not None:
+                    begin, end = byte_range if byte_range else (None, None)
+                    ok = (
+                        hashing.verify_chunks_of(
+                            memoryview(data), chunks, begin, end
+                        )
+                        is None
+                    )
+                elif key:
+                    ok = hashlib.sha256(data).hexdigest() == key
                 elif crc is not None:
                     ok = zlib.crc32(data) == crc
             if not ok:
@@ -240,7 +255,7 @@ class CachedStoragePlugin(StoragePlugin):
                     "falling back to origin and re-populating",
                     entry,
                     size,
-                    (sha or crc),
+                    (key or crc),
                 )
                 with contextlib.suppress(OSError):
                     os.remove(entry)
@@ -388,9 +403,15 @@ class CachedStoragePlugin(StoragePlugin):
         if read_io.byte_range is not None and not full_range:
             # Serve a range only from an already-cached full object; a miss
             # passes through untouched so lazy partial restores never fetch
-            # more than the ranges they asked for.
+            # more than the ranges they asked for. With a v2 chunk grid the
+            # hit verifies only the chunks the range touches.
             data = await loop.run_in_executor(
-                executor, self._read_entry, entry, expect, verify
+                executor,
+                self._read_entry,
+                entry,
+                expect,
+                verify,
+                read_io.byte_range,
             )
             if data is None:
                 telemetry.counter_add("cache.bypass_reads")

@@ -69,7 +69,9 @@ _WARNED_KEYSET_SIGS: "set" = set()
 
 # Bump when the fingerprint payload or cached-plan layout changes: stale
 # in-process caches from an older scheme must never satisfy a new build.
-_FINGERPRINT_VERSION = 2
+# v3: dedup identities key off the v2 tree-digest root, whose grain
+# (TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES) joined the knob signature.
+_FINGERPRINT_VERSION = 3
 
 def _is_jax_array(obj: Any) -> bool:
     import jax
@@ -137,6 +139,12 @@ def compute_fingerprint(
         # identical fingerprints or heterogeneous hosts would never agree
         # on a plan-cache hit (ADVICE round 5).
         knobs.get_dedup_digests_env(),
+        # The tree-digest grain is part of every v2 object's dedup/cache
+        # identity (the root is grain-dependent), so a grain change must
+        # invalidate cached plans like any other identity-shaping knob.
+        # Resolved from env only (its default derives from the stream-chunk
+        # env), so identical-env ranks resolve identically.
+        knobs.get_hash_chunk_bytes(),
     )
     payload = (
         _FINGERPRINT_VERSION,

@@ -610,6 +610,52 @@ def override_d2h_window_bytes(value: int):
     return _override_env(_ENV_D2H_WINDOW, str(value))
 
 
+_ENV_HASH_CHUNK = "TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES"
+_ENV_HASH_WORKERS = "TORCHSNAPSHOT_TPU_HASH_WORKERS"
+
+
+def get_hash_chunk_bytes() -> int:
+    """Grain of the parallel chunked hashing engine (``hashing.py``): each
+    ``HASH_CHUNK_BYTES`` slice of a storage object's byte stream is hashed
+    as an independent job on the hash pool, the per-chunk crc32s combine
+    into the bit-identical whole-object crc32 (``crc32_combine``), and the
+    content digest becomes the sha256 tree root over the ordered chunk
+    digests — recorded in a v2 sidecar whose chunk list makes RANGED reads
+    verifiable and scrub corruption chunk-attributable. Objects no larger
+    than one chunk keep the exact v1 record. Default: the stream chunk
+    grain (``TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES``), so streamed appends
+    and hash chunks share a grid. ``0`` disables chunking entirely — the
+    serial v1 fold and v1-only sidecars (the compat escape hatch and the
+    A/B baseline of ``benchmarks/staging``'s hash sweep). The grain is part
+    of a v2 object's dedup identity: keep it stable across the takes of an
+    incremental chain, or changed-grain objects re-upload."""
+    val = os.environ.get(_ENV_HASH_CHUNK)
+    if val is None:
+        return get_stream_chunk_bytes()
+    return max(0, int(val))
+
+
+def get_hash_workers() -> int:
+    """Width of the hash pool (per-operation, ``PipelinePools``): how many
+    chunk-hash jobs run concurrently. Default: the staging-thread width —
+    hashing (~1 GB/s/thread for crc+sha256) must keep pace with the
+    combined D2H lanes, and on incremental takes it replaces the skipped
+    storage write. Raise on many-core hosts where ``stage_hash_s`` still
+    brackets the drain wall."""
+    val = os.environ.get(_ENV_HASH_WORKERS)
+    if val is not None:
+        return max(1, int(val))
+    return get_staging_threads()
+
+
+def override_hash_chunk_bytes(value: int):
+    return _override_env(_ENV_HASH_CHUNK, str(value))
+
+
+def override_hash_workers(value: int):
+    return _override_env(_ENV_HASH_WORKERS, str(value))
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
