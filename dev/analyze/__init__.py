@@ -1,6 +1,6 @@
 """Checkpoint-invariant static analyzer (the ``dev/lint.py`` analysis gate).
 
-Eight AST passes over the library, zero third-party dependencies:
+Nine AST passes over the library, zero third-party dependencies:
 
 1. async-safety (TSA1xx) — no blocking calls on the event loop;
 2. task-leak (TSA2xx) — every spawned task AND executor future retained
@@ -14,7 +14,12 @@ Eight AST passes over the library, zero third-party dependencies:
 7. thread-safety (TSA7xx) — no unguarded attribute mutation shared between
    executor threads and the event loop;
 8. fault-coverage (TSA8xx) — every StoragePlugin/StorageWriteStream op
-   wrapped by FaultyStoragePlugin's injection map.
+   wrapped by FaultyStoragePlugin's injection map;
+9. collective-discipline (TSA9xx) — collective call sequences stay
+   SPMD-pure: no collective behind rank/time/filesystem/exception-derived
+   branches, none in except/finally handlers, none per-iteration of
+   divergent loops, and plan-affecting functions read only
+   manifest/knob/entry state.
 
 Run: ``python -m dev.analyze`` (or via ``python dev/lint.py``).
 See ``docs/static-analysis.md`` for codes, suppression, and the baseline

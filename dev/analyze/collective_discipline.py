@@ -1,0 +1,525 @@
+"""Pass 9 — collective discipline (TSA901-TSA904), flow-aware.
+
+Every cross-rank protocol in the library — commit/restore barriers, the
+plan-cache preflight broadcast, broadcast restore, the reshard read plans —
+rests on one invariant enforced nowhere by the interpreter: *collective call
+sequences must be SPMD-pure*, identical on every rank. One divergent rank
+deadlocks the fleet (a peer waits on a store key nobody posts) or corrupts
+it (a broadcast consumed against the wrong generation's namespace). The
+hazards are flow bugs, invisible to call-shape passes: a collective behind a
+rank-derived branch, a barrier added only in an ``except`` handler, a loop
+whose trip count differs per rank issuing a collective per pass.
+
+The **collective surface** this pass models:
+
+- coordinator collectives: ``barrier``, ``all_gather_object``,
+  ``broadcast_object``, ``gather_object``, ``scatter_object``;
+- :class:`LinearBarrier` phases: ``arrive`` / ``depart``;
+- coordinator-store ops (``set``/``get``/``try_get``/``add``/``delete``)
+  when issued on a store-named receiver (``store``/``_store``/``ns``/…) —
+  the generation-token get/set/increment traffic the collectives ride;
+- ``defer_delete`` (store-key GC registration).
+
+``report_error`` and ``note_external_barrier`` are *not* surface: the first
+is the sanctioned error fan-out (asymmetric by contract), the second is
+local bookkeeping. The protocol-implementing modules
+(``parallel/coordinator.py``, ``parallel/store.py``) are exempt — rank
+asymmetry there IS the protocol (a broadcast source sets where a sink gets).
+
+**Divergence taint**: a branch predicate or loop bound is locally divergent
+when it derives (transitively, through single-target assignments) from rank
+identity (``rank``/``*_rank``/``get_rank()``/``process_index``), wall-clock
+reads (``time.monotonic()``/…), local filesystem state
+(``os.path.*``/``listdir``/``exists``/``stat``), randomness
+(``random``/``uuid``/``os.urandom``), a caught-exception name, or a
+``gather_object`` result (None on every non-destination rank). Manifest-,
+knob-, and broadcast-derived state is untouched: collectives driven by those
+are the sanctioned SPMD idiom.
+
+Codes:
+
+- **TSA901** — a collective reachable only under a divergence-tainted
+  branch, with no matching collective on the sibling path: the ranks that
+  take the other side never issue it.
+- **TSA902** — a collective lexically inside an ``except`` handler or
+  ``finally`` body: peers on the happy path never reach it, so the handler
+  trades one failure for a fleet-wide hang.
+- **TSA903** — a loop whose iteration count is divergence-tainted issuing a
+  collective per iteration: ranks fall out of lockstep after the first
+  extra pass.
+- **TSA904** — SPMD purity of *plan-affecting* functions (broadcast
+  eligibility, read-plan construction, reshard overlap planning — pinned in
+  :data:`_SPMD_PURE_FUNCS`, extendable with a ``# spmd-pure`` marker on the
+  ``def`` line): any read of non-(manifest|knob|entry) state — wall clock,
+  local filesystem, environment outside the knob registry, randomness,
+  rank identity, memory-budget probes — inside them is a finding, because
+  their outputs feed byte-identical (path, range) plans on every rank.
+
+The runtime cross-check is the collective lockstep sanitizer
+(``TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES=1``, ``collective_tracer.py``): this
+pass proves lockstep over the CFG, the tracer proves it over executions, and
+CI runs both.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, dotted_name, iter_functions
+
+# Files implementing the collective protocol itself: rank-asymmetric store
+# traffic there is the protocol, not a divergence hazard (the lockstep
+# tracer's own cross-check exchange included — it runs strictly after a
+# barrier every rank passed).
+_IMPL_EXEMPT_SUFFIXES = (
+    "parallel/coordinator.py",
+    "parallel/store.py",
+    "collective_tracer.py",
+)
+
+_COLLECTIVE_ATTRS = {
+    "barrier",
+    "all_gather_object",
+    "broadcast_object",
+    "gather_object",
+    "scatter_object",
+    "arrive",
+    "depart",
+    "defer_delete",
+}
+
+_STORE_OPS = {"set", "get", "try_get", "add", "delete"}
+_STORE_RECEIVERS = {"store", "_store", "ns", "_ns", "kvstore"}
+
+# Plan-affecting functions pinned to SPMD purity (TSA904): their outputs
+# must be identical on every rank because peers plan broadcast sequences /
+# read requests from them. (file suffix, function name).
+_SPMD_PURE_FUNCS: Tuple[Tuple[str, str], ...] = (
+    ("bcast.py", "eligible"),
+    ("bcast.py", "elect_reader"),
+    ("bcast.py", "reader_order"),
+    ("bcast.py", "is_fully_replicated_target"),
+    ("snapshot.py", "_prepare_restore_one"),
+    ("io_preparers/sharded_array.py", "overlap"),
+    ("io_preparers/sharded_array.py", "subdivide"),
+    ("io_preparers/sharded_array.py", "prepare_read"),
+    ("io_preparers/array.py", "prepare_read"),
+    ("io_preparers/chunked_array.py", "prepare_read"),
+    ("io_preparers/object.py", "prepare_read"),
+)
+
+_TIME_CALLS = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.time_ns",
+    "time.monotonic_ns",
+    "datetime.now",
+    "datetime.datetime.now",
+}
+_FS_CALLS = {"os.stat", "os.listdir", "os.scandir", "os.walk", "os.access", "glob.glob"}
+_FS_ATTRS = {"exists", "is_file", "is_dir", "isfile", "isdir", "listdir", "scandir"}
+_RANDOM_PREFIXES = ("random.", "uuid.")
+_RANK_CALL_ATTRS = {"get_rank", "process_index"}
+
+# Impure sources inside SPMD-pure (TSA904) functions. Knob getters
+# (``knobs.*``) are explicitly legal: knobs are part of the plan's declared
+# input surface (identical across a correctly-launched fleet).
+_IMPURE_CALL_PREFIXES = (
+    "time.",
+    "random.",
+    "uuid.",
+    "socket.",
+    "platform.",
+    "psutil.",
+    "os.",
+)
+_IMPURE_BARE_CALLS = {"open", "input"}
+_IMPURE_CALL_ATTRS = _FS_ATTRS | {
+    "monotonic",
+    "perf_counter",
+    "urandom",
+    "gethostname",
+    "getpid",
+    "virtual_memory",
+}
+_IMPURE_NAME_MARKERS = ("memory_budget", "available_memory")
+
+
+def _last_attr(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is not None:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _receiver_parts(func: ast.AST) -> Set[str]:
+    """Identifier parts of the receiver chain of ``a.b.c.op`` → {a, b, c}."""
+    parts: Set[str] = set()
+    node = func
+    if isinstance(node, ast.Attribute):
+        node = node.value  # drop the op itself
+    while isinstance(node, ast.Attribute):
+        parts.add(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.add(node.id)
+    return parts
+
+
+def collective_op(call: ast.Call) -> Optional[str]:
+    """Canonical surface-op label for a call, or None."""
+    attr = _last_attr(call)
+    if attr is None:
+        return None
+    if attr in _COLLECTIVE_ATTRS:
+        return attr
+    if attr in _STORE_OPS and (_receiver_parts(call.func) & _STORE_RECEIVERS):
+        return f"store.{attr}"
+    return None
+
+
+def _rankish(name: str) -> bool:
+    return (
+        name in ("rank", "process_index")
+        or name.endswith("_rank")
+        or name.startswith("rank_")
+    )
+
+
+def _own_body_nodes(stmts):
+    """Source-ordered nodes of ``stmts``, stopping at nested function/class
+    boundaries (nested defs are analyzed as their own functions)."""
+    for stmt in stmts:
+        stack = [stmt]
+        while stack:
+            node = stack.pop(0)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack[:0] = list(ast.iter_child_nodes(node))
+
+
+def _names_outside_call_args(expr: ast.AST) -> Set[str]:
+    """Load-context names in ``expr``, NOT descending into call arguments:
+    ``is_leader = rank == 0`` ties ``is_leader`` to ``rank``, but
+    ``barrier = LinearBarrier(rank=rank, ...)`` does not taint ``barrier``
+    — an object *parameterized* by rank is not itself a divergent value
+    (branching on ``barrier is not None`` is a world-size gate, the
+    library's pervasive idiom). Divergent call RESULTS are caught by the
+    base-call taint (``get_rank()``, ``time.monotonic()``, …) instead."""
+    out: Set[str] = set()
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            stack.append(node.func)
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _collectives_in(stmts) -> List[Tuple[str, ast.Call]]:
+    out = []
+    for node in _own_body_nodes(stmts):
+        if isinstance(node, ast.Call):
+            op = collective_op(node)
+            if op is not None:
+                out.append((op, node))
+    out.sort(key=lambda t: (t[1].lineno, t[1].col_offset))
+    return out
+
+
+class _Taint:
+    """Divergence taint over one function: base-tainted expressions plus a
+    transitive closure over single-target assignments."""
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        # except-handler bound names: caught-exception identity.
+        self.exc_names: Set[str] = set()
+        for node in _own_body_nodes(fn.body):
+            if isinstance(node, ast.ExceptHandler) and node.name:
+                self.exc_names.add(node.name)
+        # name -> names its assignment reads (one level).
+        derived: Dict[str, Set[str]] = {}
+        # names whose assignment expression is base-tainted via a call.
+        tainted: Dict[str, str] = {}
+        for node in _own_body_nodes(fn.body):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    derived.setdefault(tgt.id, set()).update(
+                        _names_outside_call_args(node.value)
+                    )
+                    reason = self._expr_base_reason(node.value)
+                    if reason is not None:
+                        tainted.setdefault(tgt.id, reason)
+        # Fixpoint: a name is tainted if any name it derives from is.
+        changed = True
+        while changed:
+            changed = False
+            for name, srcs in derived.items():
+                if name in tainted:
+                    continue
+                for src in srcs:
+                    if src in tainted:
+                        tainted[name] = tainted[src]
+                        changed = True
+                        break
+                    if _rankish(src):
+                        tainted[name] = f"rank identity (`{src}`)"
+                        changed = True
+                        break
+                    if src in self.exc_names:
+                        tainted[name] = f"caught-exception identity (`{src}`)"
+                        changed = True
+                        break
+        self.tainted_names = tainted
+
+    def _call_reason(self, call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func) or ""
+        attr = _last_attr(call)
+        if attr in _RANK_CALL_ATTRS:
+            return f"rank identity (`{name or attr}()`)"
+        if name in _TIME_CALLS or attr in ("monotonic", "perf_counter"):
+            return f"wall-clock time (`{name or attr}()`)"
+        if (
+            name in _FS_CALLS
+            or name.startswith("os.path.")
+            or attr in _FS_ATTRS
+        ):
+            return f"local filesystem state (`{name or attr}()`)"
+        if name.startswith(_RANDOM_PREFIXES) or name == "os.urandom":
+            return f"randomness (`{name}()`)"
+        if attr == "gather_object":
+            return "a gather_object result (None on non-destination ranks)"
+        return None
+
+    def _expr_base_reason(self, expr: ast.AST) -> Optional[str]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                reason = self._call_reason(node)
+                if reason is not None:
+                    return reason
+        return None
+
+    def reason(self, expr: ast.AST) -> Optional[str]:
+        """Why ``expr`` is locally divergent, or None."""
+        base = self._expr_base_reason(expr)
+        if base is not None:
+            return base
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if _rankish(node.id):
+                    return f"rank identity (`{node.id}`)"
+                if node.id in self.exc_names:
+                    return f"caught-exception identity (`{node.id}`)"
+                if node.id in self.tainted_names:
+                    return (
+                        f"`{node.id}`, derived from "
+                        f"{self.tainted_names[node.id]}"
+                    )
+            elif isinstance(node, ast.Attribute):
+                if _rankish(node.attr):
+                    return f"rank identity (`.{node.attr}`)"
+        return None
+
+
+def _fn_key(fn, node: ast.AST, code: str, op: str) -> str:
+    return f"{fn.name}:{op}:{getattr(node, 'lineno', 0) - fn.lineno}"
+
+
+def _check_branches(relpath, fn, taint, findings) -> None:
+    for node in _own_body_nodes(fn.body):
+        if not isinstance(node, ast.If):
+            continue
+        reason = taint.reason(node.test)
+        if reason is None:
+            continue
+        body_ops = _collectives_in(node.body)
+        else_ops = _collectives_in(node.orelse)
+        if [op for op, _ in body_ops] == [op for op, _ in else_ops]:
+            continue
+        # Flag each collective not matched (by op multiset) on the sibling.
+        body_counts = Counter(op for op, _ in body_ops)
+        else_counts = Counter(op for op, _ in else_ops)
+        for ops, counts, sibling in (
+            (body_ops, body_counts - else_counts, "else"),
+            (else_ops, else_counts - body_counts, "if"),
+        ):
+            remaining = dict(counts)
+            for op, call in ops:
+                if remaining.get(op, 0) <= 0:
+                    continue
+                remaining[op] -= 1
+                findings.append(
+                    Finding(
+                        path=relpath,
+                        line=call.lineno,
+                        code="TSA901",
+                        message=(
+                            f"collective `{op}` in `{fn.name}` is reachable "
+                            f"only under a locally-divergent condition (line "
+                            f"{node.lineno} branches on {reason}) with no "
+                            f"matching collective on the {sibling} path — "
+                            "ranks taking the other side never issue it "
+                            "(deadlock/desync); hoist it out of the branch "
+                            "or mirror it on the sibling path"
+                        ),
+                        key=_fn_key(fn, call, "TSA901", op),
+                    )
+                )
+
+
+def _check_handlers(relpath, fn, findings) -> None:
+    for node in _own_body_nodes(fn.body):
+        if not isinstance(node, ast.Try):
+            continue
+        regions = [
+            (handler.body, "an `except` handler") for handler in node.handlers
+        ]
+        if node.finalbody:
+            regions.append((node.finalbody, "a `finally` block"))
+        for body, where in regions:
+            for op, call in _collectives_in(body):
+                findings.append(
+                    Finding(
+                        path=relpath,
+                        line=call.lineno,
+                        code="TSA902",
+                        message=(
+                            f"collective `{op}` in `{fn.name}` is issued "
+                            f"inside {where} (try at line {node.lineno}) — "
+                            "peers on the happy path never reach it, so the "
+                            "handler trades one rank's failure for a "
+                            "fleet-wide hang; report through "
+                            "`report_error`/structured aborts instead, or "
+                            "issue the collective on every path"
+                        ),
+                        key=_fn_key(fn, call, "TSA902", op),
+                    )
+                )
+
+
+def _check_loops(relpath, fn, taint, findings) -> None:
+    for node in _own_body_nodes(fn.body):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            bound, what = node.iter, "iterates over"
+        elif isinstance(node, ast.While):
+            if isinstance(node.test, ast.Constant):
+                continue  # `while True` polling loops converge elsewhere
+            bound, what = node.test, "is bounded by"
+        else:
+            continue
+        reason = taint.reason(bound)
+        if reason is None:
+            continue
+        for op, call in _collectives_in(node.body):
+            findings.append(
+                Finding(
+                    path=relpath,
+                    line=call.lineno,
+                    code="TSA903",
+                    message=(
+                        f"collective `{op}` in `{fn.name}` is issued per "
+                        f"iteration of the loop at line {node.lineno}, which "
+                        f"{what} {reason} — the trip count can differ across "
+                        "ranks, so peers fall out of lockstep after the "
+                        "first extra pass; derive the bound from "
+                        "manifest/knob/broadcast state or hoist the "
+                        "collective out of the loop"
+                    ),
+                    key=_fn_key(fn, call, "TSA903", op),
+                )
+            )
+
+
+def _spmd_pure_targets(ctx: AnalysisContext, relpath: str, tree) -> List[ast.AST]:
+    lines = ctx.lines(relpath)
+    out = []
+    for fn in iter_functions(tree):
+        pinned = any(
+            relpath.endswith(suffix) and fn.name == name
+            for suffix, name in _SPMD_PURE_FUNCS
+        )
+        marked = False
+        if 1 <= fn.lineno <= len(lines) and "spmd-pure" in lines[fn.lineno - 1]:
+            marked = True
+        if pinned or marked:
+            out.append(fn)
+    return out
+
+
+def _check_purity(relpath, fn, findings) -> None:
+    for node in _own_body_nodes(fn.body):
+        problem: Optional[str] = None
+        line = getattr(node, "lineno", fn.lineno)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            attr = _last_attr(node)
+            if (
+                name.startswith(_IMPURE_CALL_PREFIXES)
+                or name in _IMPURE_BARE_CALLS
+                or attr in _IMPURE_CALL_ATTRS
+                or attr in _RANK_CALL_ATTRS
+                or any(
+                    marker in (name or attr or "")
+                    for marker in _IMPURE_NAME_MARKERS
+                )
+            ):
+                problem = f"call to `{name or attr}`"
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if _rankish(node.id):
+                problem = f"read of rank identity `{node.id}`"
+        if problem is not None:
+            findings.append(
+                Finding(
+                    path=relpath,
+                    line=line,
+                    code="TSA904",
+                    message=(
+                        f"`{fn.name}` is SPMD-purity-pinned (its output "
+                        "feeds rank-identical plans) but contains a "
+                        f"{problem}: only manifest-entry, knob, and "
+                        "argument-derived state is legal here — move the "
+                        "impure read to the caller or drop the function "
+                        "from the plan-affecting surface"
+                    ),
+                    key=f"{fn.name}:{problem}:{line - fn.lineno}",
+                )
+            )
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in ctx.lib_files:
+        tree = ctx.tree(relpath)
+        if tree is None:
+            continue
+        exempt = relpath.endswith(_IMPL_EXEMPT_SUFFIXES)
+        pure_targets = _spmd_pure_targets(ctx, relpath, tree)
+        for fn in iter_functions(tree):
+            if fn in pure_targets:
+                _check_purity(relpath, fn, findings)
+            if exempt:
+                continue
+            has = any(
+                isinstance(n, ast.Call) and collective_op(n) is not None
+                for n in _own_body_nodes(fn.body)
+            )
+            if not has:
+                continue
+            taint = _Taint(fn)
+            _check_branches(relpath, fn, taint, findings)
+            _check_handlers(relpath, fn, findings)
+            _check_loops(relpath, fn, taint, findings)
+    return findings

@@ -443,6 +443,7 @@ def get_passes():
     can list passes even if one module is mid-edit."""
     from . import (
         async_safety,
+        collective_discipline,
         fault_coverage,
         knob_drift,
         manifest_schema,
@@ -461,6 +462,7 @@ def get_passes():
         ("resource-balance", resource_balance.run),
         ("thread-safety", thread_safety.run),
         ("fault-coverage", fault_coverage.run),
+        ("collective-discipline", collective_discipline.run),
     ]
 
 

@@ -14,8 +14,9 @@ box:
   never stay invisible in the human-facing docs;
 - the checkpoint-invariant static analyzer (``dev/analyze``: async-safety,
   task/future leaks, knob/telemetry drift, manifest schema, flow-sensitive
-  resource balance, cross-thread mutation, fault-injection coverage — see
-  ``docs/static-analysis.md``) over the library package.
+  resource balance, cross-thread mutation, fault-injection coverage,
+  collective discipline — see ``docs/static-analysis.md``) over the
+  library package.
 
     python dev/lint.py            # lint + analyze the repo
     python dev/lint.py FILES...   # lint specific files (analyzer runs too)
@@ -129,7 +130,7 @@ def fix_file(path: str) -> bool:
 
 
 def check_analyzer(paths: list) -> int:
-    """The static-analysis gate (``python -m dev.analyze``): all eight
+    """The static-analysis gate (``python -m dev.analyze``): all nine
     passes (see dev/analyze/__init__.py). Subprocess so the analyzer's
     import path (repo root) never depends on how lint was invoked."""
     import subprocess

@@ -64,6 +64,17 @@ def _debug_ledger():
         yield
 
 
+@pytest.fixture(autouse=True)
+def _debug_collectives():
+    """...and under the collective lockstep sanitizer
+    (TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES=1, inherited by child ranks): no
+    fault schedule may provoke a rank into issuing a divergent collective
+    sequence — the runtime cross-check of the static TSA9xx
+    collective-discipline pass."""
+    with knobs.override_debug_collectives(True):
+        yield
+
+
 # ---------------------------------------------------------------------------
 # Backend plumbing. Inspection (listing, metadata probes) always goes through
 # a PRISTINE plugin (_resolve_storage_plugin: no fault wrapper), so the

@@ -1,0 +1,233 @@
+"""Unit tests for the collective lockstep sanitizer (collective_tracer.py).
+
+The multiprocess acceptance test (an injected divergent collective named by
+rank + site across 2 real ranks) lives in test_multiprocess.py; these cover
+the tracer's local contracts: sequence/fingerprint math, main-thread
+gating, store cross-check + key GC, divergence attribution to the exact
+call site, and the off-mode zero-allocation guarantee.
+"""
+
+import threading
+
+import pytest
+
+from torchsnapshot_tpu import collective_tracer as ct
+from torchsnapshot_tpu.parallel.store import LinearBarrier, LocalStore
+from torchsnapshot_tpu.utils import knobs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    ct.reset_tracer()
+    yield
+    ct.reset_tracer()
+
+
+# ---------------------------------------------------------------------------
+# Sequence / fingerprint math
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_numbers_are_monotonic_and_digest_rolls():
+    t = ct.CollectiveTracer()
+    s1 = t.record("coord.barrier", "coll/barrier/1")
+    d1 = t.digest()
+    s2 = t.record("coord.broadcast_object", "coll/broadcast/2")
+    d2 = t.digest()
+    assert (s1, s2) == (1, 2)
+    assert d1[0] == 1 and d2[0] == 2
+    assert d1[1] != d2[1]  # every checked op folds into the fingerprint
+
+
+def test_fingerprint_is_order_sensitive():
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    a.record("op.x", "k1")
+    a.record("op.y", "k2")
+    b.record("op.y", "k2")
+    b.record("op.x", "k1")
+    assert a.digest()[0] == b.digest()[0] == 2
+    assert a.digest()[1] != b.digest()[1]  # same multiset, different order
+
+
+def test_fingerprint_depends_on_key_not_just_op():
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    a.record("coord.barrier", "coll/barrier/1")
+    b.record("coord.barrier", "coll/barrier/2")
+    assert a.digest()[1] != b.digest()[1]
+
+
+def test_unchecked_ops_journal_without_advancing_the_digest():
+    t = ct.CollectiveTracer()
+    t.record("coord.barrier", "coll/barrier/1")
+    before = t.digest()
+    t.record("coord.defer_delete", "bcastx/abc/0/0", checked=False)
+    t.record("barrier.report_error", "commit/1/p", checked=False)
+    assert t.digest() == before
+    assert len(t.unchecked_entries()) == 2
+    assert len(t.checked_entries()) == 1
+
+
+def test_off_main_thread_records_are_unchecked():
+    # The async-commit barrier records from its background thread: journaled
+    # for attribution, excluded from the lockstep fingerprint (its
+    # interleaving against main-thread planning is timing, not divergence).
+    t = ct.CollectiveTracer()
+    done = threading.Event()
+
+    def bg():
+        t.record("barrier.arrive", "async_commit/1/p")
+        done.set()
+
+    threading.Thread(target=bg).start()
+    assert done.wait(5)
+    assert t.digest() == (0, "")
+    assert len(t.unchecked_entries()) == 1
+
+
+def test_site_attribution_names_this_file():
+    t = ct.CollectiveTracer()
+    t.record("coord.barrier", "coll/barrier/1")
+    (_, _, _, site) = t.checked_entries()[0]
+    assert "test_collective_tracer.py" in site
+    assert "test_site_attribution_names_this_file" in site
+
+
+# ---------------------------------------------------------------------------
+# Cross-check protocol
+# ---------------------------------------------------------------------------
+
+
+def _crosscheck_pair(store, a, b, tag, timeout_s=5.0):
+    """Run both ranks' crosschecks concurrently; return {rank: error|None}."""
+    out = {}
+
+    def run(rank, tracer):
+        try:
+            tracer.crosscheck(store, rank, 2, tag, timeout_s=timeout_s)
+            out[rank] = None
+        except Exception as e:  # noqa: BLE001 - collected for assertions
+            out[rank] = e
+
+    th = threading.Thread(target=run, args=(1, b))
+    th.start()
+    run(0, a)
+    th.join(timeout=timeout_s + 5)
+    assert not th.is_alive()
+    return out
+
+
+def test_crosscheck_passes_in_lockstep_and_gcs_prior_keys():
+    store = LocalStore()
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    for t in (a, b):
+        t.record("coord.broadcast_object", "coll/broadcast/1")
+    out = _crosscheck_pair(store, a, b, "round1")
+    assert out == {0: None, 1: None}
+    assert store.try_get("colltrace/round1/0") is not None
+    # The next crosscheck reclaims each rank's previous posting (every rank
+    # passed round1 by then, so nobody can still be reading its keys).
+    for t in (a, b):
+        t.record("coord.barrier", "coll/barrier/2")
+    out = _crosscheck_pair(store, a, b, "round2")
+    assert out == {0: None, 1: None}
+    assert store.try_get("colltrace/round1/0") is None
+    assert store.try_get("colltrace/round1/1") is None
+    assert store.try_get("colltrace/round2/0") is not None
+
+
+def test_crosscheck_world_one_is_a_no_op():
+    t = ct.CollectiveTracer()
+    t.record("coord.barrier", "coll/barrier/1")
+    t.crosscheck(LocalStore(), 0, 1, "solo")  # must not post or block
+
+
+def test_divergence_names_both_sites_and_first_divergent_seq():
+    store = LocalStore()
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    for t in (a, b):
+        t.record("coord.broadcast_object", "coll/broadcast/1")
+    b.record("coord.gather_object", "coll/gather/2")  # the divergent op
+    a.record("coord.barrier", "coll/barrier/2")
+    b.record("coord.barrier", "coll/barrier/3")
+    out = _crosscheck_pair(store, a, b, "check")
+    assert isinstance(out[0], ct.CollectiveDivergenceError)
+    assert isinstance(out[1], ct.CollectiveDivergenceError)
+    for rank, e in out.items():
+        assert e.seq == 2, e
+        assert {e.rank_a, e.rank_b} == {0, 1}
+        assert e.site_a and e.site_b
+        msg = str(e)
+        assert "first divergent sequence number 2" in msg
+        assert "coord.gather_object" in msg and "coord.barrier" in msg
+        assert "test_collective_tracer.py" in msg
+
+
+def test_divergence_with_missing_trailing_entry():
+    # Rank 1 issued one extra trailing collective: the first divergent seq
+    # is past rank 0's journal, reported as <no collective ...> on rank 0.
+    store = LocalStore()
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    for t in (a, b):
+        t.record("coord.barrier", "coll/barrier/1")
+    b.record("coord.broadcast_object", "coll/broadcast/2")
+    out = _crosscheck_pair(store, a, b, "check")
+    e = out[0]
+    assert isinstance(e, ct.CollectiveDivergenceError)
+    assert e.seq == 2
+    assert "<no collective at this sequence number>" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# LinearBarrier integration + knob gating
+# ---------------------------------------------------------------------------
+
+
+def test_linear_barrier_records_and_crosschecks_under_the_knob():
+    store = LocalStore()
+    with knobs.override_debug_collectives(True):
+        tracer = ct.active_tracer()
+        assert tracer is not None
+
+        # world=1 barrier: records the phases; crosscheck is a no-op.
+        barrier = LinearBarrier(store, "t1", rank=0, world_size=1)
+        barrier.arrive(timeout_s=5)
+        barrier.depart(timeout_s=5)
+        entries = tracer.checked_entries()
+        assert [op for _, op, _, _ in entries] == [
+            "barrier.arrive",
+            "barrier.depart",
+        ]
+        assert all(key == "t1" for _, _, key, _ in entries)
+
+        # report_error is journaled unchecked (asymmetric by contract).
+        barrier.report_error(RuntimeError("boom"), phase="write")
+        assert [op for _, op, _, _ in tracer.unchecked_entries()] == [
+            "barrier.report_error"
+        ]
+        assert tracer.digest()[0] == 2
+
+
+def test_knob_off_allocates_no_tracer_and_adds_no_journal():
+    assert ct.active_tracer() is None
+    assert ct._TRACER is None
+    # The instrumented paths must stay silent with the knob off.
+    store = LocalStore()
+    barrier = LinearBarrier(store, "t2", rank=0, world_size=1)
+    barrier.arrive(timeout_s=5)
+    barrier.depart(timeout_s=5)
+    assert ct._TRACER is None
+
+
+def test_coordinator_barrier_crosschecks_and_diverged_extra_op_is_caught():
+    # Two Coordinator objects sharing one LocalStore *on the main thread*
+    # can't run a real two-rank barrier concurrently; drive the tracer the
+    # way the coordinator does — record per collective, crosscheck at the
+    # barrier tag — to pin the tag contract (generation-derived, identical
+    # across ranks even when sequence counts differ).
+    store = LocalStore()
+    a, b = ct.CollectiveTracer(), ct.CollectiveTracer()
+    for t in (a, b):
+        t.record("coord.all_gather_object", "coll/all_gather/1")
+        t.record("coord.barrier", "coll/barrier/2")
+    out = _crosscheck_pair(store, a, b, "coll/barrier/2")
+    assert out == {0: None, 1: None}

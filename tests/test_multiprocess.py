@@ -12,8 +12,20 @@ import numpy as np
 import pytest
 
 from torchsnapshot_tpu.test_utils import run_with_processes
+from torchsnapshot_tpu.utils import knobs
 
 pytestmark = pytest.mark.multiprocess
+
+
+@pytest.fixture(autouse=True)
+def _debug_collectives():
+    """The whole multiprocess suite runs under the collective lockstep
+    sanitizer (TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES=1, inherited by the
+    spawned ranks): every take/restore/reshard flow here must issue an
+    identical collective sequence on every rank — the runtime cross-check
+    of the static TSA9xx collective-discipline pass."""
+    with knobs.override_debug_collectives(True):
+        yield
 
 
 # ---------------------------------------------------------------------------
@@ -380,3 +392,45 @@ def _worker_telemetry_artifacts(rank: int, world_size: int, shared: str) -> None
 
 def test_telemetry_artifacts_all_ranks(tmp_path) -> None:
     run_with_processes(_worker_telemetry_artifacts, nproc=2, args=(str(tmp_path),))
+
+
+def _worker_divergent_collective_is_named(rank: int, world_size: int, shared: str) -> None:
+    # ISSUE 11 acceptance: with the lockstep sanitizer on, an injected
+    # divergent collective is detected at the next barrier on EVERY rank,
+    # and the error names both ranks' call sites and the first divergent
+    # sequence number. The injection is a `gather_object` issued by rank 1
+    # alone — the one collective that completes locally on a non-destination
+    # rank (it only posts), i.e. exactly the silent-desync shape the tracer
+    # exists to catch before the subsequent namespace-skewed hang.
+    from torchsnapshot_tpu.collective_tracer import CollectiveDivergenceError
+    from torchsnapshot_tpu.parallel.coordinator import get_coordinator
+    from torchsnapshot_tpu.parallel.store import LinearBarrier
+
+    os.environ["TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES"] = "1"
+    coord = get_coordinator()
+    # Symmetric prologue: one broadcast every rank issues identically.
+    coord.broadcast_object({"step": 1} if rank == 0 else None, src=0)
+    if rank == 1:
+        coord.gather_object("divergent", dst=0)  # noqa: TSA901 - the seeded hazard
+    barrier = LinearBarrier(coord.store, "lockstep-check", rank, world_size)
+    try:
+        barrier.arrive(timeout_s=60.0)
+    except CollectiveDivergenceError as e:
+        # First divergent sequence number: broadcast is seq 1 on both ranks;
+        # seq 2 is rank 0's barrier arrive vs rank 1's injected gather.
+        assert e.seq == 2, e
+        assert {e.rank_a, e.rank_b} == {0, 1}, e
+        msg = str(e)
+        assert "coord.gather_object" in msg, msg
+        assert "barrier.arrive" in msg, msg
+        # Both call sites resolved to this test file.
+        assert msg.count("test_multiprocess.py") == 2, msg
+        assert "first divergent sequence number 2" in msg, msg
+        return
+    raise AssertionError("divergent collective was not detected")
+
+
+def test_divergent_collective_named_by_rank_and_site(tmp_path) -> None:
+    run_with_processes(
+        _worker_divergent_collective_is_named, nproc=2, args=(str(tmp_path),)
+    )

@@ -444,10 +444,16 @@ def _worker_restore_constant_round_trips(rank, world_size, shared):
     expected = {"all_gather": 0, "gather": 2, "broadcast": 2, "barrier": 0}
     assert small_counts == expected, small_counts
     assert big_counts == expected, big_counts
-    # The barrier-release `set` lands on whichever rank arrives last, so a
-    # single op of run-to-run jitter is inherent; a per-key design would
-    # differ by >= 2 ops per extra key.
-    assert abs(small_ops - big_ops) <= 1, (small_ops, big_ops)
+    # Timing jitter in the op totals is inherent and load-dependent (NOT a
+    # per-key cost): the barrier-release `set` lands on whichever rank
+    # arrives last (1 op), and every extra second of cross-rank skew in the
+    # LinearBarrier wait loop re-polls `try_get(error)` + `get(done)` (2
+    # ops per cycle — observed under full-suite load, where this margin at
+    # <= 1 was an order-dependent flake). The decisive signal is an order
+    # of magnitude larger: a per-key design pays >= 2 ops per extra key,
+    # i.e. >= 8 ops across the 4-key spread measured here — so assert
+    # strictly below that, robust to scheduler noise from prior tests.
+    assert abs(small_ops - big_ops) < 8, (small_ops, big_ops)
 
 
 def test_restore_constant_round_trips(tmp_path) -> None:

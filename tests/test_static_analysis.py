@@ -833,6 +833,225 @@ def test_fault_coverage_quiet_when_surface_fully_wrapped(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass 9: collective discipline
+# ---------------------------------------------------------------------------
+
+
+def test_collective_discipline_flags_rank_conditional_broadcast(tmp_path):
+    # The seeded-hazard shapes from the acceptance criteria: a
+    # rank-conditional broadcast_object (one taken straight, one through a
+    # derived flag) — the ranks on the other side wait on a key nobody
+    # posts.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            def bad_direct(coord, rank, cfg):
+                if rank == 0:
+                    coord.broadcast_object(cfg, src=0)
+
+            def bad_derived(coord, rank):
+                is_leader = rank == 0
+                if is_leader:
+                    coord.barrier()
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA901", "TSA901"]
+    assert "broadcast_object" in found[0].message
+    assert "rank identity" in found[0].message
+    assert "derived from rank identity" in found[1].message
+
+
+def test_collective_discipline_flags_time_and_gather_conditionals(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+
+            def bad_time(coord, deadline):
+                if time.monotonic() > deadline:
+                    coord.barrier()
+
+            def bad_gather(coord, obj):
+                gathered = coord.gather_object(obj, dst=0)
+                if gathered is not None:
+                    coord.barrier()
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA901", "TSA901"]
+    assert "wall-clock" in found[0].message
+    assert "gather_object result" in found[1].message
+
+
+def test_collective_discipline_flags_barrier_in_except(tmp_path):
+    # The acceptance shape "a barrier added only in an except branch": the
+    # happy-path ranks never reach it — one failure becomes a fleet hang.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            def bad_handler(coord, work):
+                try:
+                    work()
+                except Exception:
+                    coord.barrier()
+                    raise
+
+            def bad_finally(barrier, work):
+                try:
+                    work()
+                finally:
+                    barrier.arrive()
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA902", "TSA902"]
+    assert "`except` handler" in found[0].message
+    assert "`finally` block" in found[1].message
+
+
+def test_collective_discipline_flags_data_dependent_collective_loop(tmp_path):
+    # The acceptance shape "a data-dependent collective loop": trip counts
+    # derived from local filesystem state / wall clock differ across ranks.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+            import time
+
+            def bad_listing_loop(coord, d):
+                for f in os.listdir(d):
+                    coord.broadcast_object(f, src=0)
+
+            def bad_deadline_loop(ns, deadline):
+                while time.monotonic() < deadline:
+                    ns.add("progress", 1)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA903", "TSA903"]
+    assert "local filesystem state" in found[0].message
+    assert "wall-clock" in found[1].message
+    assert "store.add" in found[1].message
+
+
+def test_collective_discipline_quiet_on_sanctioned_idioms(tmp_path):
+    # The library's real shapes: leader-only work BETWEEN symmetric barrier
+    # phases, a world-size gate on a barrier object merely parameterized by
+    # rank, collectives matched on both sides of a rank branch, loops over
+    # broadcast/knob-derived bounds, report_error in handlers, and
+    # constant-test polling loops.
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            from . import knobs
+
+            def leader_commit(barrier, rank, write_metadata):
+                barrier.arrive()
+                if rank == 0:
+                    write_metadata()
+                barrier.depart()
+
+            def world_size_gate(store, coord, rank, path):
+                barrier = None
+                if coord.get_world_size() > 1:
+                    barrier = LinearBarrier(
+                        store=store, barrier_id=path, rank=rank, world_size=2
+                    )
+                if barrier is not None:
+                    barrier.arrive()
+                    barrier.depart()
+
+            def matched_roles(coord, rank, cfg):
+                if rank == 0:
+                    decision = coord.broadcast_object(cfg, src=0)
+                else:
+                    decision = coord.broadcast_object(None, src=0)
+                return decision
+
+            def spmd_loop(coord, app_state):
+                keys = coord.broadcast_object(sorted(app_state), src=0)
+                for key in keys:
+                    coord.broadcast_object(key, src=0)
+
+            def knob_bounded_attempts(ns):
+                for attempt in range(1 + knobs.get_reelect_max()):
+                    ns.try_get(str(attempt))
+
+            def error_fanout(barrier, work, phase):
+                try:
+                    work()
+                except Exception as e:
+                    barrier.report_error(e, phase=phase)
+                    raise
+
+            def polling(ns, key):
+                while True:
+                    payload = ns.try_get(key)
+                    if payload is not None:
+                        return payload
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+def test_collective_discipline_spmd_pure_marker(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            from . import knobs
+
+            def bad_fs_probe(entry):  # spmd-pure
+                if os.path.exists(entry.location):
+                    return False
+                return entry.nbytes <= knobs.get_max_bytes()
+
+            def bad_rank_read(entry, rank):  # spmd-pure
+                return entry.nbytes + rank
+
+            def good_plan(entry):  # spmd-pure
+                limit = knobs.get_max_bytes()
+                return [c.location for c in entry.chunks if c.nbytes <= limit]
+
+            def unmarked_impure_is_fine(entry):
+                return os.path.exists(entry.location)
+            """
+        },
+    )
+    found = run_passes(ctx)
+    assert codes(found) == ["TSA904", "TSA904"]
+    assert "os.path.exists" in found[0].message
+    assert "rank identity" in found[1].message
+
+
+def test_collective_discipline_noqa_suppresses(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            def deliberate(coord, rank, cfg):
+                if rank == 0:
+                    coord.broadcast_object(cfg, src=0)  # noqa: TSA901
+            """
+        },
+    )
+    assert run_passes(ctx) == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline mechanics
 # ---------------------------------------------------------------------------
 

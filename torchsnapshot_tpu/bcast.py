@@ -111,7 +111,7 @@ def reset_diagnostics() -> None:
     )
 
 
-def is_fully_replicated_target(live: Any) -> bool:
+def is_fully_replicated_target(live: Any) -> bool:  # spmd-pure
     """Whether ``live`` implies every process restores the WHOLE array —
     the condition under which a sharded saved entry's read set is identical
     across ranks (and broadcast therefore wins). True for host targets
@@ -131,7 +131,7 @@ def is_fully_replicated_target(live: Any) -> bool:
     return True
 
 
-def eligible(entry: Entry, live: Any) -> bool:
+def eligible(entry: Entry, live: Any) -> bool:  # spmd-pure
     """SPMD-pure broadcast eligibility: derived from the manifest entry,
     env knobs, and the (globally consistent) target kind only."""
     max_bytes = knobs.get_broadcast_max_bytes()
@@ -161,7 +161,9 @@ def eligible(entry: Entry, live: Any) -> bool:
     return False
 
 
-def elect_reader(path: str, byte_range: Optional[Tuple[int, int]], world: int) -> int:
+def elect_reader(  # spmd-pure
+    path: str, byte_range: Optional[Tuple[int, int]], world: int
+) -> int:
     """Stable reader election, spreading replicated objects across ranks.
     sha1 (not ``hash``): identical across processes regardless of hash
     randomization."""
@@ -171,7 +173,7 @@ def elect_reader(path: str, byte_range: Optional[Tuple[int, int]], world: int) -
     ) % max(1, world)
 
 
-def reader_order(
+def reader_order(  # spmd-pure
     path: str, byte_range: Optional[Tuple[int, int]], world: int
 ) -> List[int]:
     """The full re-election order for one object: the sha1-elected reader

@@ -920,7 +920,7 @@ class ArrayIOPreparer:
         return entry, reqs
 
     @staticmethod
-    def prepare_read(
+    def prepare_read(  # spmd-pure
         entry: ArrayEntry,
         target: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,

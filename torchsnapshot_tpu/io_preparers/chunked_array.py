@@ -67,7 +67,7 @@ class ChunkedArrayIOPreparer:
         return entry, write_reqs
 
     @staticmethod
-    def prepare_read(
+    def prepare_read(  # spmd-pure
         entry: ChunkedArrayEntry,
         target: np.ndarray,
         buffer_size_limit_bytes: Optional[int] = None,

@@ -75,6 +75,8 @@ class ObjectIOPreparer:
         ]
 
     @staticmethod
-    def prepare_read(entry: ObjectEntry) -> Tuple[List[ReadReq], ObjectBufferConsumer]:
+    def prepare_read(  # spmd-pure
+        entry: ObjectEntry,
+    ) -> Tuple[List[ReadReq], ObjectBufferConsumer]:
         consumer = ObjectBufferConsumer(entry)
         return [ReadReq(path=entry.location, buffer_consumer=consumer)], consumer

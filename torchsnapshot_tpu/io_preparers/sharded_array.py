@@ -81,7 +81,7 @@ def local_unique_shards(arr: Any) -> List[Tuple[Any, List[int], List[int], int]]
     return out
 
 
-def subdivide(
+def subdivide(  # spmd-pure
     offsets: List[int],
     sizes: List[int],
     itemsize: int,
@@ -108,7 +108,7 @@ def subdivide(
     return pieces
 
 
-def overlap(
+def overlap(  # spmd-pure
     src_off: Sequence[int],
     src_sz: Sequence[int],
     dst_off: Sequence[int],
@@ -351,7 +351,7 @@ class ShardedArrayIOPreparer:
         return entry, write_reqs
 
     @staticmethod
-    def prepare_read(
+    def prepare_read(  # spmd-pure
         entry: ShardedArrayEntry,
         targets: List[TargetShard],
         buffer_size_limit_bytes: Optional[int] = None,

@@ -20,6 +20,7 @@ from __future__ import annotations
 import pickle
 from typing import Any, List, Optional
 
+from ..collective_tracer import active_tracer
 from ..utils import knobs
 from .store import (
     JaxCoordinationStore,
@@ -96,7 +97,12 @@ class Coordinator:
         namespace (e.g. broadcast-restore payload keys) for the same
         deferred GC the collectives get: deleted best-effort once a later
         full-world barrier proves every rank has finished reading it.
-        Main-thread only."""
+        Main-thread only. Asymmetric by design (only the posting rank
+        registers its own key), so the lockstep tracer journals it
+        unchecked — local GC bookkeeping, not a collective."""
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.defer_delete", key, checked=False)
         self._posted.append((self._generation, key))
 
     # -- collectives --------------------------------------------------------
@@ -105,6 +111,9 @@ class Coordinator:
             return
         timeout_s = _resolve_timeout(timeout_s)
         ns, prefix = self._next_ns("barrier")
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.barrier", prefix)
         count = ns.add("count", 1)
         if count == self._world_size:
             ns.set("done", b"1")
@@ -112,6 +121,13 @@ class Coordinator:
             self._post(f"{prefix}/count")
         ns.get("done", timeout_s=timeout_s)
         self._last_barrier_gen = self._generation
+        if tracer is not None:
+            # Every rank just passed this barrier, so the rendezvous for the
+            # lockstep cross-check is guaranteed; the tag derives from the
+            # (identical-when-in-lockstep) generation namespace.
+            tracer.crosscheck(
+                self._store, self._rank, self._world_size, prefix, timeout_s
+            )
 
     def all_gather_object(
         self, obj: Any, timeout_s: Optional[float] = None
@@ -120,6 +136,9 @@ class Coordinator:
             return [obj]
         timeout_s = _resolve_timeout(timeout_s)
         ns, prefix = self._next_ns("all_gather")
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.all_gather_object", prefix)
         ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
         self._post(f"{prefix}/{self._rank}")
         return [
@@ -134,6 +153,9 @@ class Coordinator:
             return obj
         timeout_s = _resolve_timeout(timeout_s)
         ns, prefix = self._next_ns("broadcast")
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.broadcast_object", prefix)
         if self._rank == src:
             ns.set("obj", pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
             self._post(f"{prefix}/obj")
@@ -147,6 +169,9 @@ class Coordinator:
             return [obj]
         timeout_s = _resolve_timeout(timeout_s)
         ns, prefix = self._next_ns("gather")
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.gather_object", prefix)
         ns.set(str(self._rank), pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
         self._post(f"{prefix}/{self._rank}")
         if self._rank != dst:
@@ -164,6 +189,9 @@ class Coordinator:
             return objs[0]
         timeout_s = _resolve_timeout(timeout_s)
         ns, prefix = self._next_ns("scatter")
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record("coord.scatter_object", prefix)
         if self._rank == src:
             assert objs is not None and len(objs) == self._world_size
             for r, o in enumerate(objs):

@@ -472,6 +472,7 @@ class LinearBarrier:
 
     def __init__(self, store: Store, barrier_id: str, rank: int, world_size: int):
         self._store = store.prefix(f"barrier/{barrier_id}")
+        self._barrier_id = barrier_id
         self._rank = rank
         self._world_size = world_size
 
@@ -505,6 +506,11 @@ class LinearBarrier:
         )
 
     def _phase(self, phase: str, timeout_s: float) -> None:
+        from ..collective_tracer import active_tracer
+
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record(f"barrier.{phase}", self._barrier_id)
         count = self._store.add(phase, 1)
         if count == self._world_size:
             self._store.set(f"{phase}/done", b"1")
@@ -520,6 +526,22 @@ class LinearBarrier:
                 err = self._store.try_get("error")
                 if err is not None:
                     raise self._unpickle_error(err)
+                if tracer is not None and (
+                    threading.current_thread() is threading.main_thread()
+                ):
+                    # Every rank just passed this phase; cross-check the
+                    # lockstep fingerprint under the barrier's own (rank-
+                    # independent) namespace. Background-thread barriers
+                    # (the async commit) skip the check: their interleaving
+                    # against main-thread planning collectives is timing,
+                    # not SPMD divergence.
+                    tracer.crosscheck(
+                        self._store,
+                        self._rank,
+                        self._world_size,
+                        phase,
+                        timeout_s,
+                    )
                 return
             except TimeoutError:
                 if time.monotonic() > deadline:
@@ -529,6 +551,15 @@ class LinearBarrier:
                     )
 
     def report_error(self, e: Exception, phase: Optional[str] = None) -> None:
+        from ..collective_tracer import active_tracer
+
+        tracer = active_tracer()
+        if tracer is not None:
+            # Only the failing rank posts: asymmetric by design, journaled
+            # for attribution but excluded from the lockstep fingerprint.
+            tracer.record(
+                "barrier.report_error", self._barrier_id, checked=False
+            )
         self._store.set(
             "error", pickle.dumps((self._rank, phase, repr(e)))
         )

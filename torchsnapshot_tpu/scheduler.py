@@ -177,6 +177,11 @@ def derive_local_world_size(coordinator=None) -> int:
         # Gather to rank 0 + broadcast the list back: constant store
         # round-trips per non-zero rank (an all_gather costs O(world) store
         # reads on EVERY rank, and this runs on the restore/restart path).
+        # SPMD contract: every rank calls this at the same program point
+        # (gated on world size only, never on rank/local state) — enforced
+        # statically by the TSA9xx collective-discipline pass and at
+        # runtime by the collective lockstep tracer
+        # (TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES).
         gathered = coordinator.gather_object(socket.gethostname(), dst=0)
         hostnames = coordinator.broadcast_object(gathered, src=0)
         local_world_size = max(1, hostnames.count(socket.gethostname()))

@@ -2588,7 +2588,7 @@ def _fetch_frame_tables(
     return tables
 
 
-def _prepare_restore_one(
+def _prepare_restore_one(  # spmd-pure
     logical_path: str,
     entry: Entry,
     live: Any,

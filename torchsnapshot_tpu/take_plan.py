@@ -316,6 +316,10 @@ def preflight(
             )
         hit = tokens[0] is not None and all(t == tokens[0] for t in tokens)
         decision = (hit, paths[0], bases[0], sorted(common))
+    # Broadcast OUTSIDE the rank-0 block above: the decision collective
+    # must be issued by every rank (src posts, sinks read) — keeping it
+    # under the `gathered is not None` branch would be exactly the TSA901
+    # rank-conditional-collective hazard the analyzer now gates.
     decision = coord.broadcast_object(decision, src=0)
     hit, canonical_path, canonical_base, common_globs = decision
     return PreflightResult(

@@ -141,7 +141,11 @@ def _worker_entry(
             # on early-failure paths, or retry-connect to a dead server.
             if _coord_mod._CACHED is not None:
                 store = _coord_mod._CACHED.store
-                store.add("__launcher_exit__", 1)
+                # Deliberately asymmetric, best-effort shutdown accounting
+                # (the whole drain is wrapped fail-open and no peer WAITS on
+                # these counters — a rank that dies here just shortens rank
+                # 0's linger): not a lockstep collective.
+                store.add("__launcher_exit__", 1)  # noqa: TSA902
                 if rank == 0:
                     # Bounded linger; tests that kill peers outright can
                     # shrink it so the survivor doesn't idle out the full
@@ -151,7 +155,9 @@ def _worker_entry(
                     drain_s = knobs.get_launcher_drain_s()
                     deadline = _time.monotonic() + drain_s
                     while _time.monotonic() < deadline:
-                        if store.add("__launcher_exit__", 0) >= world_size:
+                        # Rank 0 alone polls the exit counter (time-bounded,
+                        # fail-open): the linger protocol, not lockstep.
+                        if store.add("__launcher_exit__", 0) >= world_size:  # noqa: TSA901,TSA902,TSA903
                             break
                         _time.sleep(0.05)
         except Exception:

@@ -783,6 +783,29 @@ def override_debug_ledger(enabled: bool):
     return _override_env(_ENV_DEBUG_LEDGER, "1" if enabled else "0")
 
 
+_ENV_DEBUG_COLLECTIVES = "TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES"
+
+
+def is_debug_collectives_enabled() -> bool:
+    """Debug-mode collective lockstep sanitizer: when set, every coordinator
+    collective and commit/restore barrier phase is journaled with a monotonic
+    sequence number, op-kind/key fingerprint, and originating call site, and
+    the rolling fingerprint is cross-checked against every peer through the
+    coordinator store at each barrier — a divergent rank raises a
+    ``CollectiveDivergenceError`` naming both ranks' call sites and the first
+    divergent sequence number (see ``collective_tracer.py`` and
+    ``docs/robustness.md``). The runtime cross-check of the static TSA9xx
+    collective-discipline pass; enabled across the chaos matrix and the
+    multiprocess suites in CI. Off (the default) allocates nothing."""
+    return os.environ.get(_ENV_DEBUG_COLLECTIVES, "") not in (
+        "", "0", "false", "False",
+    )
+
+
+def override_debug_collectives(enabled: bool):
+    return _override_env(_ENV_DEBUG_COLLECTIVES, "1" if enabled else "0")
+
+
 _ENV_READ_CACHE_DIR = "TORCHSNAPSHOT_TPU_READ_CACHE_DIR"
 _ENV_READ_CACHE_BYTES = "TORCHSNAPSHOT_TPU_READ_CACHE_BYTES"
 _ENV_READ_CACHE_VERIFY = "TORCHSNAPSHOT_TPU_READ_CACHE_VERIFY"
