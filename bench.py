@@ -68,12 +68,62 @@ def build_params(total_gb: float, seed: int = 0):
     return params, nbytes
 
 
+def make_link_probe_record(rates, device) -> dict:
+    """The link probe's self-description, embedded in the round artifact so
+    the regression gate (this round and every later one) can tell whether
+    two rounds' ``drain_vs_link`` ratios are comparable AT ALL.
+
+    The r06 miss this exists to prevent: a host change put the probe on a
+    CPU backend, where ``np.asarray(device_array)`` measures a ~655 GB/s
+    memcpy instead of a ~GB/s device link — the ratio collapsed to 0.0 and
+    the gate flagged a phantom regression (the mirror failure, a probe
+    suddenly SLOWER, would have masked a real one). A probe is recorded as
+    **degenerate** when the device platform is ``cpu`` (there is no
+    device link; the copy is host memory bandwidth) or the measured rate
+    exceeds any plausible host interconnect (64 GB/s — past PCIe gen5
+    x16 territory, so it can only be a memcpy)."""
+    import platform as platform_mod
+
+    rate = statistics_median(rates)
+    degenerate = device.platform == "cpu" or rate > 64.0
+    return {
+        "method": "device_get_np_asarray_0.13GB_bf16",
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "host": {
+            "machine": platform_mod.machine(),
+            "cpus": os.cpu_count(),
+        },
+        "rates_gbps": [round(r, 4) for r in rates],
+        "degenerate": degenerate,
+    }
+
+
+def statistics_median(values):
+    import statistics
+
+    return statistics.median(values)
+
+
+def _probe_fingerprint(probe: dict) -> tuple:
+    """What must match for two rounds' link measurements to be
+    like-for-like: same probe method against the same device kind on the
+    same backend. Host CPU details are recorded for humans but don't gate
+    (the link is a device property)."""
+    return (
+        probe.get("method"),
+        probe.get("platform"),
+        probe.get("device_kind"),
+    )
+
+
 def regression_gate(
     size_gb: float,
     drain_s: float,
     drain_vs_link: float,
     restore_s: float = 0.0,
     stage_hash_s: float = 0.0,
+    link_probe: dict = None,
 ) -> dict:
     """Fail-soft regression gate: compare this run's drain wall,
     drain_vs_link, restore wall, AND drain hash time (``stage_hash_s`` —
@@ -87,10 +137,21 @@ def regression_gate(
     silently. An EMPTY prior trajectory (first round on a workload, or the
     artifacts were moved) is itself reported loudly as ``no_prior`` rather
     than silently skipping the comparison. Priors that predate a metric
-    simply don't constrain it."""
+    simply don't constrain it.
+
+    ``drain_vs_link`` is special (the r06 lesson): the ratio is only
+    meaningful between LIKE-FOR-LIKE probes. It is compared solely against
+    priors whose recorded ``link_probe`` fingerprint (method, platform,
+    device kind) matches this round's AND whose probe was not degenerate;
+    a degenerate probe this round skips the ratio gate entirely, loudly.
+    Priors that predate the probe record can't prove comparability and are
+    excluded from the ratio comparison (their drain/restore/hash walls
+    still gate). A host change can therefore neither fake a vs-link
+    regression nor mask one."""
     try:
         return _regression_gate_impl(
-            size_gb, drain_s, drain_vs_link, restore_s, stage_hash_s
+            size_gb, drain_s, drain_vs_link, restore_s, stage_hash_s,
+            link_probe or {},
         )
     except Exception as e:  # pragma: no cover - the gate is fail-soft
         log(f"WARNING: bench regression gate errored ({e!r}); skipping")
@@ -103,6 +164,7 @@ def _regression_gate_impl(
     drain_vs_link: float,
     restore_s: float,
     stage_hash_s: float,
+    link_probe: dict,
 ) -> dict:
     import glob
 
@@ -125,6 +187,7 @@ def _regression_gate_impl(
                             "stage_hash_s", 0.0
                         )
                     ),
+                    det.get("link_probe") or {},
                 )
             )
         except Exception:
@@ -138,21 +201,54 @@ def _regression_gate_impl(
         log(f"WARNING: bench regression gate: {note}")
         return {"status": "no_prior", "priors": 0, "note": note}
     best_drain_s = min(p[1] for p in priors)
-    best_vs_link = max(p[2] for p in priors)
+    # Like-for-like ratio priors only: same probe fingerprint, both sides
+    # non-degenerate. Priors with NO probe record predate the fingerprint
+    # and can't prove comparability — excluded from the ratio comparison
+    # (recorded below so the exclusion itself is visible).
+    link_comparable = [
+        p
+        for p in priors
+        if p[5]
+        and not p[5].get("degenerate")
+        and _probe_fingerprint(p[5]) == _probe_fingerprint(link_probe)
+    ]
+    link_excluded = len(priors) - len(link_comparable)
+    best_vs_link = (
+        max(p[2] for p in link_comparable) if link_comparable else 0.0
+    )
     restore_priors = [p[3] for p in priors if p[3] > 0]
     best_restore_s = min(restore_priors) if restore_priors else 0.0
     hash_priors = [p[4] for p in priors if p[4] > 0]
     best_hash_s = min(hash_priors) if hash_priors else 0.0
     problems = []
+    link_note = None
     if drain_s > best_drain_s * 1.10:
         problems.append(
             f"drain wall {drain_s:.2f}s is >10% over the best prior "
             f"{best_drain_s:.2f}s"
         )
-    if drain_vs_link < best_vs_link - 0.05:
+    if link_probe.get("degenerate"):
+        link_note = (
+            "this round's link probe is degenerate "
+            f"({link_probe.get('platform')} backend at "
+            f"{max(link_probe.get('rates_gbps') or [0.0]):.1f} GB/s is a "
+            "memcpy, not a device link): drain_vs_link is not gated this "
+            "round"
+        )
+        log(f"WARNING: bench regression gate: {link_note}")
+    elif not link_comparable:
+        link_note = (
+            f"no prior round carries a matching non-degenerate link-probe "
+            f"fingerprint ({link_excluded} prior(s) excluded): "
+            "drain_vs_link seeds a fresh like-for-like trajectory this "
+            "round"
+        )
+        log(f"WARNING: bench regression gate: {link_note}")
+    elif drain_vs_link < best_vs_link - 0.05:
         problems.append(
             f"drain_vs_link {drain_vs_link:.2f} dropped more than 0.05 "
-            f"below the best prior {best_vs_link:.2f}"
+            f"below the best like-for-like prior {best_vs_link:.2f} "
+            f"({len(link_comparable)} comparable prior(s))"
         )
     if restore_s > 0 and best_restore_s > 0 and restore_s > best_restore_s * 1.10:
         problems.append(
@@ -174,15 +270,19 @@ def _regression_gate_impl(
         )
     for p in problems:
         log(f"WARNING: bench regression gate: {p}")
-    return {
+    out = {
         "status": "regression" if problems else "ok",
         "priors": len(priors),
+        "link_comparable_priors": len(link_comparable),
         "best_prior_drain_s": round(best_drain_s, 2),
         "best_prior_drain_vs_link": round(best_vs_link, 2),
         "best_prior_restore_s": round(best_restore_s, 2),
         "best_prior_stage_hash_s": round(best_hash_s, 2),
         "problems": problems,
     }
+    if link_note:
+        out["link_note"] = link_note
+    return out
 
 
 def measure_naive_save(params_slice, root: str):
@@ -294,6 +394,16 @@ def main() -> None:
         link_gbps = statistics.median([link_before, link_after])
         drain_gbps = gb / drain_s
         drain_vs_link = drain_gbps / link_gbps
+        # Probe self-description (method + device + host fingerprint +
+        # degeneracy): rounds are only vs-link-comparable when these match.
+        link_probe = make_link_probe_record([link_before, link_after], d)
+        if link_probe["degenerate"]:
+            log(
+                f"WARNING: link probe is degenerate on this host "
+                f"({d.platform} backend, {link_gbps:.1f} GB/s is host "
+                "memory bandwidth, not a device link): drain_vs_link is "
+                "recorded but not meaningful this round"
+            )
         log(f"background drain (D2H + storage I/O): {drain_s:.2f}s {drain_stats}")
         # stage_busy decomposed (the PR-6 attribution): where staging time
         # actually went. With parallel lanes the sub-streams overlap, so
@@ -316,8 +426,9 @@ def main() -> None:
         )
         # The drain is a D2H-bound stream on this link; its wall must track
         # bytes/link-rate. Flag (don't abort: the probes themselves ride a
-        # drifting tunnel) when it runs >15% under the bracketing link rate.
-        if drain_vs_link < 0.85:
+        # drifting tunnel) when it runs >15% under the bracketing link rate
+        # — unless the probe is degenerate, where the ratio means nothing.
+        if drain_vs_link < 0.85 and not link_probe["degenerate"]:
             log(
                 f"WARNING: background drain ran at {drain_vs_link:.2f}x of "
                 "the link rate measured around it (target >= 0.85): the "
@@ -601,6 +712,7 @@ def main() -> None:
             drain_vs_link,
             restore_s,
             stage_hash_s=stage_breakdown.get("stage_hash_s", 0.0),
+            link_probe=link_probe,
         )
         log(f"regression gate: {gate}")
 
@@ -619,6 +731,7 @@ def main() -> None:
                         "drain_gbps": round(drain_gbps, 4),
                         "link_gbps_around_drain": round(link_gbps, 4),
                         "drain_vs_link": round(drain_vs_link, 2),
+                        "link_probe": link_probe,
                         "stall_phases_s": stall_phases,
                         "drain_stats_s": drain_stats,
                         "stage_breakdown_s": stage_breakdown,

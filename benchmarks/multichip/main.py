@@ -1,0 +1,180 @@
+"""Per-device drain scaling: drain GB/s vs device count, as a curve.
+
+The MULTICHIP harness (``__graft_entry__.dryrun_multichip``) proves the
+checkpoint path composes with an 8-device mesh — but only as a smoke. This
+bench promotes it to a first-class scaling measurement (ROADMAP item 1,
+"go bigger"): for each device count N it spawns a fresh process with N
+devices, shards one large parameter array across a flat ``(N,)`` mesh, and
+drives an ``async_take`` whose background drain runs **N per-device D2H
+lanes and N per-shard ``write_stream``s concurrently** (transfer lanes
+sized to the device count; streaming writes on). The emitted artifact is
+the drain-GB/s-vs-device-count curve — the write-side analogue of the
+stall trajectory, and the regression surface for "the drain scales with
+devices", not just "the drain is fast on one chip".
+
+Fresh process per N: the device count is fixed at backend initialization
+(``--xla_force_host_platform_device_count`` on CPU hosts; the first N real
+devices otherwise), so the sweep cannot run in one process.
+
+One JSON line on stdout; progress on stderr.
+
+  python benchmarks/multichip/main.py                        # 1,2,4,8 x 256 MB
+  MULTICHIP_BENCH_DEVICES=1,2 MULTICHIP_BENCH_MB=32 \
+  python benchmarks/multichip/main.py                        # fast smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def child(n_devices: int, total_mb: float, out_path: str) -> None:
+    """One sweep cell: N devices, one flat-sharded array, one async_take.
+    Runs in a fresh process (the parent set XLA_FLAGS/JAX_PLATFORMS)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.utils import knobs
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, (
+        f"wanted {n_devices} devices, backend exposes {len(devices)}"
+    )
+    mesh = Mesh(np.array(devices), ("all",))
+    rows = max(n_devices, int(total_mb * 1e6 / 2 / 16384))
+    rows -= rows % n_devices  # evenly shardable
+    host = np.arange(rows * 16384, dtype=np.uint16).reshape(rows, 16384)
+    arr = jax.device_put(
+        host.view(jax.numpy.bfloat16.dtype), NamedSharding(mesh, P("all"))
+    )
+    jax.block_until_ready(arr)
+    payload_gb = arr.nbytes / 1e9
+
+    root = tempfile.mkdtemp(prefix="tss_multichip_")
+    try:
+        # Per-device transfer lanes + per-shard write_streams: the drain
+        # should hold one lane and one storage stream busy per device.
+        with knobs.override_d2h_lanes(max(4, n_devices)), (
+            knobs.override_stream_writes(True)
+        ):
+            # Warmup absorbs compile/native-engine costs outside the
+            # measured drain.
+            Snapshot.take(os.path.join(root, "warm"), {"m": StateDict(x=arr)})
+            t0 = time.perf_counter()
+            pending = Snapshot.async_take(
+                os.path.join(root, "ckpt"), {"m": StateDict(x=arr)}
+            )
+            stall_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            pending.wait()
+            drain_s = time.perf_counter() - t0
+        ds = pending.drain_stats
+        rec = {
+            "devices": n_devices,
+            "payload_gb": round(payload_gb, 4),
+            "stall_s": round(stall_s, 4),
+            "drain_s": round(drain_s, 4),
+            "drain_gbps": round(payload_gb / max(drain_s, 1e-9), 4),
+            "stage_busy_s": round(ds.get("stage_busy_s", 0.0), 3),
+            "io_busy_s": round(ds.get("io_busy_s", 0.0), 3),
+            "overlap_s": round(ds.get("overlap_s", 0.0), 3),
+        }
+        with open(out_path, "w") as f:
+            json.dump(rec, f)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def run_cell(n_devices: int, total_mb: float) -> dict:
+    out_path = tempfile.mktemp(suffix=".json", prefix="tss_multichip_cell_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    # Force the virtual device count on CPU hosts; appended last so it wins
+    # over any pre-set flag (last duplicate wins in XLA).
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--child",
+                str(n_devices),
+                str(total_mb),
+                out_path,
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cell N={n_devices} failed:\n{proc.stderr[-2000:]}"
+            )
+        with open(out_path) as f:
+            return json.load(f)
+    finally:
+        if os.path.exists(out_path):
+            os.remove(out_path)
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), float(sys.argv[3]), sys.argv[4])
+        return
+    total_mb = float(os.environ.get("MULTICHIP_BENCH_MB", "256"))
+    device_counts = [
+        int(n)
+        for n in os.environ.get("MULTICHIP_BENCH_DEVICES", "1,2,4,8").split(
+            ","
+        )
+        if n.strip()
+    ]
+    curve = []
+    for n in device_counts:
+        rec = run_cell(n, total_mb)
+        curve.append(rec)
+        log(f"N={n}: {rec}")
+    best = max(curve, key=lambda r: r["drain_gbps"])
+    base = curve[0]
+    print(
+        json.dumps(
+            {
+                "metric": "drain_gbps_at_max_devices",
+                "value": curve[-1]["drain_gbps"],
+                "unit": "GB/s",
+                "detail": {
+                    "payload_mb": total_mb,
+                    "curve": curve,
+                    "scaling_vs_single": round(
+                        curve[-1]["drain_gbps"]
+                        / max(base["drain_gbps"], 1e-9),
+                        3,
+                    ),
+                    "best": {
+                        "devices": best["devices"],
+                        "drain_gbps": best["drain_gbps"],
+                    },
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,13 @@ fleet of K replicas and measures the three serving-path mechanisms:
   (asserted from ``bcast.LAST_RESTORE_BCAST`` gathered across ranks);
 - **lazy partial reads**: ``read_object`` of one tower's manifest subtree
   fetches only that subtree's bytes (asserted against the tower/total
-  payload ratio from storage read counters).
+  payload ratio from storage read counters);
+- **swarm restore** (``TORCHSNAPSHOT_TPU_SWARM_RESTORE``): K real ranks
+  cold-restore ONE replicated object too big for broadcast via the
+  chunk-granular swarm, at K ∈ ``SERVING_BENCH_SWARM_KS`` (default 2,4,8);
+  asserted per K: every chunk origin-read by **exactly one rank**
+  fleet-wide, **total origin bytes ≤ 1.1× one snapshot independent of K**,
+  and every peer-received chunk verified against the sidecar v2 grid.
 
 One JSON line on stdout; progress on stderr.
 
@@ -204,6 +210,107 @@ def run_bcast_leg(total_mb: float, ranks: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _swarm_worker(
+    rank: int, world: int, path: str, total_mb: float, result_path: str
+) -> None:
+    """One fleet rank of the swarm leg: take ONE replicated object too big
+    for broadcast, cold-restore it via the chunk swarm, and gather the
+    per-rank swarm records so rank 0 can assert the headline invariants."""
+    from torchsnapshot_tpu import swarm as swarm_mod
+    from torchsnapshot_tpu.parallel.coordinator import get_coordinator
+
+    nbytes = int(total_mb * 1e6)
+    arr = np.frombuffer(
+        np.random.default_rng(11).bytes(nbytes), dtype=np.uint8
+    ).copy()
+    # One big replicated array; a small grain keeps the chunk grid wide
+    # enough that every rank gets assigned chunks even at K=8.
+    grain = max(64 * 1024, nbytes // 64)
+    with knobs.override_hash_chunk_bytes(grain):
+        Snapshot.take(path, {"app": StateDict(w=arr)}, replicated=["app/*"])
+    tgt = StateDict(w=np.zeros(nbytes, np.uint8))
+    # Cap broadcast far below the object so mode selection picks swarm.
+    with knobs.override_swarm_restore(True), knobs.override_broadcast_max_bytes(
+        64 * 1024
+    ):
+        t0 = time.perf_counter()
+        Snapshot(path).restore({"app": tgt})
+        wall = time.perf_counter() - t0
+    assert np.array_equal(tgt["w"], arr), "swarm restore not bit-exact"
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    coord = get_coordinator()
+    gathered = coord.all_gather_object(
+        {
+            "wall_s": wall,
+            "origin_reads": [list(x) for x in d["origin_reads"]],
+            "origin_bytes": d["origin_bytes"],
+            "peer_bytes": d["peer_bytes"],
+            "chunks": d["chunks"],
+            "chunks_peer": d["chunks_peer"],
+            "peer_chunks_verified": d["peer_chunks_verified"],
+        }
+    )
+    if rank == 0:
+        walls = [g["wall_s"] for g in gathered]
+        all_reads = [tuple(x) for g in gathered for x in g["origin_reads"]]
+        total_origin = sum(g["origin_bytes"] for g in gathered)
+        rec = {
+            "ranks": world,
+            "restore_p50_s": round(_pct(walls, 0.50), 4),
+            "restore_p99_s": round(_pct(walls, 0.99), 4),
+            "chunks": gathered[0]["chunks"],
+            "origin_chunk_reads_total": len(all_reads),
+            "origin_chunk_reads_unique": len(set(all_reads)),
+            "origin_bytes_total": total_origin,
+            "origin_bytes_vs_snapshot": round(total_origin / nbytes, 3),
+            "peer_bytes_total": sum(g["peer_bytes"] for g in gathered),
+            "peer_chunks_total": sum(g["chunks_peer"] for g in gathered),
+            "peer_chunks_verified": sum(
+                g["peer_chunks_verified"] for g in gathered
+            ),
+        }
+        # The headline asserts: every chunk origin-read EXACTLY once
+        # fleet-wide, total origin bytes ≈ one snapshot independent of K,
+        # every peer-received chunk verified against the sidecar grid.
+        assert (
+            rec["origin_chunk_reads_total"]
+            == rec["origin_chunk_reads_unique"]
+            == rec["chunks"]
+        ), rec
+        assert rec["origin_bytes_total"] <= 1.1 * nbytes, rec
+        assert rec["peer_chunks_verified"] == rec["peer_chunks_total"] > 0, rec
+        with open(result_path, "w") as f:
+            json.dump(rec, f)
+
+
+def run_swarm_leg(total_mb: float, ranks_list) -> dict:
+    """Chunk-swarm cold start at K∈ranks_list: origin bytes must stay ≈ one
+    snapshot (and cold-start p99 ≈ flat) as the fleet grows — the curve
+    broadcast restore cannot produce above its payload cap."""
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    out = {}
+    for ranks in ranks_list:
+        root = tempfile.mkdtemp(prefix="tss_serving_swarm_")
+        result_path = os.path.join(root, "results.json")
+        try:
+            run_with_processes(
+                _swarm_worker,
+                nproc=ranks,
+                args=(os.path.join(root, "snap"), total_mb, result_path),
+                timeout_s=600.0,
+            )
+            with open(result_path) as f:
+                rec = json.load(f)
+            out[str(ranks)] = rec
+            log(f"swarm K={ranks}: {rec}")
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    # Flat-in-K: origin bytes at the largest K stay within 10% of one
+    # snapshot, same as the smallest K (asserted per K above already).
+    return out
+
+
 def run_lazy_leg(origin_root: str, total_mb: float) -> dict:
     """Read ONE tower's subtree; origin bytes must track the tower's size,
     not the snapshot's."""
@@ -243,6 +350,12 @@ def main() -> None:
     replicas = int(os.environ.get("SERVING_BENCH_REPLICAS", "8"))
     bcast_on = os.environ.get("SERVING_BENCH_BCAST", "1") not in ("0", "false")
     bcast_ranks = int(os.environ.get("SERVING_BENCH_BCAST_RANKS", "8"))
+    swarm_on = os.environ.get("SERVING_BENCH_SWARM", "1") not in ("0", "false")
+    swarm_ks = [
+        int(k)
+        for k in os.environ.get("SERVING_BENCH_SWARM_KS", "2,4,8").split(",")
+        if k.strip()
+    ]
 
     origin_root = tempfile.mkdtemp(prefix="tss_serving_")
     try:
@@ -254,6 +367,7 @@ def main() -> None:
         lazy = run_lazy_leg(origin_root, total_mb)
         cache = run_cache_leg(origin_root, total_mb, replicas)
         bcast_res = run_bcast_leg(total_mb, bcast_ranks) if bcast_on else {}
+        swarm_res = run_swarm_leg(total_mb, swarm_ks) if swarm_on else {}
 
         print(
             json.dumps(
@@ -266,6 +380,7 @@ def main() -> None:
                         "replicas": replicas,
                         "cache": cache,
                         "broadcast": bcast_res,
+                        "swarm": swarm_res,
                         "lazy_subtree": lazy,
                         "restore_stats": {
                             k: v

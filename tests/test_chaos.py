@@ -1263,6 +1263,197 @@ def test_chaos_restore_stalled_reader_reelected_both_ranks_complete(
 
 
 # ---------------------------------------------------------------------------
+# Fast multiprocess: swarm restore under peer-serving faults. All legs run
+# under the module's autouse budget-ledger + collective-lockstep fixtures
+# (env inherited by the spawned ranks), so no fault schedule may leak a
+# budget debit or provoke a divergent collective sequence.
+# ---------------------------------------------------------------------------
+
+def _swarm_chaos_state(shared):
+    import numpy as _np
+
+    from torchsnapshot_tpu import Snapshot as Snap, StateDict as SD
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path = os.path.join(shared, "ckpt")
+    state = SD(
+        w=_np.arange(100000, dtype=_np.float32),
+        v=_np.arange(50000, dtype=_np.float64),
+    )
+    with _knobs.override_hash_chunk_bytes(65536):
+        Snap.take(path, {"app": state}, replicated=["app/*"])
+    tgt = SD(w=_np.zeros(100000, _np.float32), v=_np.zeros(50000, _np.float64))
+    return path, state, tgt
+
+
+def _worker_swarm_peer_killed(rank, world_size, shared) -> None:
+    import json
+    import time as _time
+
+    import numpy as _np
+
+    from torchsnapshot_tpu import (
+        CheckpointAbortedError as Aborted,
+        Snapshot as Snap,
+    )
+    from torchsnapshot_tpu import swarm as swarm_mod
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    os.environ["TORCHSNAPSHOT_TPU_BARRIER_TIMEOUT_S"] = "8"
+    os.environ["TORCHSNAPSHOT_TPU_LAUNCHER_DRAIN_S"] = "1"
+    path, state, tgt = _swarm_chaos_state(shared)
+    if rank == 1:
+        # Death mid-serve: rank 1 dies at its FIRST peer-serving point,
+        # before posting anything for its assigned chunks.
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = "op=peer_serve,kind=kill"
+    t0 = _time.monotonic()
+    try:
+        with _knobs.override_swarm_restore(True), (
+            _knobs.override_broadcast_max_bytes(1024)
+        ), _knobs.override_swarm_chunk_deadline_s(0.5):
+            Snap(path).restore({"app": tgt})
+        raise AssertionError("restore must abort: a peer died mid-swarm")
+    except Aborted as e:
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 60, f"abort took {elapsed:.1f}s (timeout 8s)"
+        assert e.phase and e.phase.startswith("restore."), e
+    # Only the survivor reaches here — and despite the dead peer it holds
+    # EVERY byte (re-elected itself / fell back to origin per chunk)
+    # before the structured abort at the post-load barrier.
+    assert _np.array_equal(tgt["w"], state["w"])
+    assert _np.array_equal(tgt["v"], state["v"])
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    assert d["reelections"] + d["direct_fallbacks"] >= 1, d
+    with open(os.path.join(shared, f"survivor_{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "reelections": d["reelections"],
+                "direct_fallbacks": d["direct_fallbacks"],
+            },
+            f,
+        )
+
+
+@pytest.mark.multiprocess
+def test_chaos_swarm_peer_death_mid_serve(tmp_path) -> None:
+    """Swarm peer death mid-serve: the survivor detects the missed chunk
+    deadlines, re-elects itself per chunk (and past the budget reads the
+    chunks directly from origin), holds every byte, and the restore still
+    ends in a structured abort (the fleet lost a rank) — never a hang,
+    never a partial load."""
+    with pytest.raises(RuntimeError) as exc_info:
+        run_with_processes(
+            _worker_swarm_peer_killed, nproc=2, args=(str(tmp_path),)
+        )
+    msg = str(exc_info.value)
+    assert "rank 1" in msg and f"(exitcode {KILL_EXIT_CODE})" in msg, msg
+    assert os.path.exists(str(tmp_path / "survivor_0.json"))
+
+
+def _worker_swarm_corrupt_peer(rank, world_size, shared) -> None:
+    import json
+
+    import numpy as _np
+
+    from torchsnapshot_tpu import Snapshot as Snap
+    from torchsnapshot_tpu import swarm as swarm_mod
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path, state, tgt = _swarm_chaos_state(shared)
+    if rank == 1:
+        # Every chunk rank 1 serves is corrupted IN THE POSTED COPY only
+        # (its own buffer stays clean): the receiving peer's per-chunk
+        # verification must catch each one, attribute it to rank 1, and
+        # heal from a direct origin read.
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = "op=peer_serve,kind=corrupt"
+    with _knobs.override_swarm_restore(True), (
+        _knobs.override_broadcast_max_bytes(1024)
+    ):
+        Snap(path).restore({"app": tgt})
+    # BOTH ranks end bit-exact: peer corruption is healed, never loaded.
+    assert _np.array_equal(tgt["w"], state["w"])
+    assert _np.array_equal(tgt["v"], state["v"])
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    with open(os.path.join(shared, f"diag_{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "peer_verify_failures": d["peer_verify_failures"],
+                "peer_corruptions": d["peer_corruptions"],
+                "chunks_peer": d["chunks_peer"],
+            },
+            f,
+        )
+
+
+@pytest.mark.multiprocess
+def test_chaos_swarm_corrupt_peer_chunk_caught_and_attributed(
+    tmp_path,
+) -> None:
+    """A peer serving corrupt chunks: per-chunk receipt verification
+    catches every one, attributes it to the serving rank, and heals from
+    origin — the restore completes bit-exact on every rank."""
+    import json
+
+    run_with_processes(
+        _worker_swarm_corrupt_peer, nproc=2, args=(str(tmp_path),)
+    )
+    diags = [
+        json.load(open(str(tmp_path / f"diag_{r}.json"))) for r in range(2)
+    ]
+    # Rank 0 received rank 1's corrupted serves and attributed them.
+    assert diags[0]["peer_verify_failures"] >= 1, diags
+    assert all(
+        c["from_rank"] == 1 for c in diags[0]["peer_corruptions"]
+    ), diags
+    # Rank 1 (the corruptor) received CLEAN chunks from rank 0.
+    assert diags[1]["peer_verify_failures"] == 0, diags
+
+
+def _worker_swarm_stalled_peer(rank, world_size, shared) -> None:
+    import json
+
+    import numpy as _np
+
+    from torchsnapshot_tpu import Snapshot as Snap
+    from torchsnapshot_tpu import swarm as swarm_mod
+    from torchsnapshot_tpu.utils import knobs as _knobs
+
+    path, state, tgt = _swarm_chaos_state(shared)
+    if rank == 0:
+        # Rank 0's FIRST serve stalls far past the chunk deadline but the
+        # rank stays alive: the peer re-elects per chunk and finishes; the
+        # stalled rank finishes too (its late post lands under its own
+        # attempt fence and corrupts nothing).
+        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = (
+            "op=peer_serve,kind=stall,secs=2,times=1"
+        )
+    with _knobs.override_swarm_restore(True), (
+        _knobs.override_broadcast_max_bytes(1024)
+    ), _knobs.override_swarm_chunk_deadline_s(0.3):
+        Snap(path).restore({"app": tgt})
+    assert _np.array_equal(tgt["w"], state["w"])
+    assert _np.array_equal(tgt["v"], state["v"])
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    with open(os.path.join(shared, f"diag_{rank}.json"), "w") as f:
+        json.dump({"reelections": d["reelections"]}, f)
+
+
+@pytest.mark.multiprocess
+def test_chaos_swarm_stalled_peer_hits_chunk_deadline(tmp_path) -> None:
+    """A slow-but-alive serving rank: the waiting peer re-elects the chunk
+    past SWARM_CHUNK_DEADLINE_S and completes; both ranks end bit-exact."""
+    import json
+
+    run_with_processes(
+        _worker_swarm_stalled_peer, nproc=2, args=(str(tmp_path),)
+    )
+    diags = [
+        json.load(open(str(tmp_path / f"diag_{r}.json"))) for r in range(2)
+    ]
+    assert sum(d["reelections"] for d in diags) >= 1, diags
+
+
+# ---------------------------------------------------------------------------
 # The slow restore matrix: read-fault schedules x backends x cache
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,52 @@
+"""Per-device drain-scaling bench harness: fast tier-1 smoke + the
+slow-lane sweep (ROADMAP item 1: make multi-device drain a measured curve,
+not a smoke)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_bench(devices: str, mb: int, timeout: int = 420) -> dict:
+    out = subprocess.run(
+        [sys.executable, "benchmarks/multichip/main.py"],
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "JAX_PLATFORMS": "cpu",
+            "MULTICHIP_BENCH_DEVICES": devices,
+            "MULTICHIP_BENCH_MB": str(mb),
+        },
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _check_curve(det: dict, expected_devices) -> None:
+    curve = det["curve"]
+    assert [c["devices"] for c in curve] == expected_devices
+    for cell in curve:
+        assert cell["drain_gbps"] > 0
+        assert cell["drain_s"] > 0
+        assert cell["payload_gb"] > 0
+        # The drain decomposition rode along (attributable cells).
+        assert "stage_busy_s" in cell and "io_busy_s" in cell
+    assert det["scaling_vs_single"] > 0
+
+
+def test_multichip_bench_smoke_tiny() -> None:
+    rec = _run_bench(devices="1,2", mb=8)
+    assert rec["metric"] == "drain_gbps_at_max_devices"
+    _check_curve(rec["detail"], [1, 2])
+
+
+@pytest.mark.slow
+def test_multichip_bench_full_sweep() -> None:
+    """The full 1→8 virtual-device curve at a size where every cell
+    streams; the artifact IS the scaling trajectory."""
+    rec = _run_bench(devices="1,2,4,8", mb=128, timeout=900)
+    _check_curve(rec["detail"], [1, 2, 4, 8])

@@ -15,7 +15,11 @@ import pytest
 
 
 def _run_bench(
-    mb: int, replicas: int, bcast_ranks: int, timeout: int = 420
+    mb: int,
+    replicas: int,
+    bcast_ranks: int,
+    timeout: int = 420,
+    swarm_ks: str = "2",
 ) -> dict:
     out = subprocess.run(
         [sys.executable, "benchmarks/serving/main.py"],
@@ -25,6 +29,7 @@ def _run_bench(
             "SERVING_BENCH_MB": str(mb),
             "SERVING_BENCH_REPLICAS": str(replicas),
             "SERVING_BENCH_BCAST_RANKS": str(bcast_ranks),
+            "SERVING_BENCH_SWARM_KS": swarm_ks,
         },
         capture_output=True,
         text=True,
@@ -50,17 +55,39 @@ def _check(det: dict, ranks: int) -> None:
     assert lazy["subtree_bytes"] > 0
 
 
+def _check_swarm(det: dict, ks) -> None:
+    """The swarm leg's headline invariants, per fleet size K: every chunk
+    origin-read exactly once fleet-wide, total origin bytes ≤ 1.1× one
+    snapshot INDEPENDENT of K, every peer-received chunk verified."""
+    sw = det["swarm"]
+    for k in ks:
+        rec = sw[str(k)]
+        assert rec["ranks"] == k
+        assert (
+            rec["origin_chunk_reads_total"]
+            == rec["origin_chunk_reads_unique"]
+            == rec["chunks"]
+        ), rec
+        assert rec["origin_bytes_vs_snapshot"] <= 1.1, rec
+        assert rec["peer_chunks_verified"] == rec["peer_chunks_total"] > 0, rec
+
+
 def test_serving_bench_smoke_tiny() -> None:
-    rec = _run_bench(mb=4, replicas=3, bcast_ranks=2)
+    rec = _run_bench(mb=4, replicas=3, bcast_ranks=2, swarm_ks="2")
     assert rec["metric"] == "serving_cold_start_restore_p50"
     _check(rec["detail"], ranks=2)
+    _check_swarm(rec["detail"], ks=[2])
 
 
 @pytest.mark.slow
 def test_serving_bench_fleet() -> None:
     """Acceptance-scale: K=8 simulated replicas cold-starting from one
-    snapshot, broadcast across 8 real ranks."""
-    rec = _run_bench(mb=64, replicas=8, bcast_ranks=8, timeout=600)
+    snapshot, broadcast across 8 real ranks, and the swarm leg at
+    K∈{2,4,8} — origin bytes ≈ one snapshot at every fleet size."""
+    rec = _run_bench(
+        mb=64, replicas=8, bcast_ranks=8, timeout=900, swarm_ks="2,4,8"
+    )
     det = rec["detail"]
     _check(det, ranks=8)
     assert det["replicas"] == 8
+    _check_swarm(det, ks=[2, 4, 8])

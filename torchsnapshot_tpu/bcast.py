@@ -107,6 +107,13 @@ def reset_diagnostics() -> None:
             "entries": 0,
             "reelections": 0,
             "direct_fallbacks": 0,
+            # Per-object origin-byte attribution: storage path ->
+            # {"origin_bytes", "peer_bytes"} as THIS rank obtained it
+            # (fetched/direct-fallback vs received). The production-side
+            # witness of "origin bytes ~= one snapshot regardless of K":
+            # summed across ranks, each object's origin_bytes should be
+            # ~its size, not K x its size.
+            "per_object": {},
         }
     )
 
@@ -131,34 +138,79 @@ def is_fully_replicated_target(live: Any) -> bool:  # spmd-pure
     return True
 
 
-def eligible(entry: Entry, live: Any) -> bool:  # spmd-pure
-    """SPMD-pure broadcast eligibility: derived from the manifest entry,
-    env knobs, and the (globally consistent) target kind only."""
-    max_bytes = knobs.get_broadcast_max_bytes()
+def replicated_read_cost(entry: Entry, live: Any) -> Optional[int]:  # spmd-pure
+    """The bytes EVERY rank would redundantly read from origin for this
+    entry when restored directly — i.e. whether the entry is shaped for a
+    collective restore path at all — or None when it is not (not
+    replicated, raw-range views, sharded entry onto a non-replicated
+    target). SPMD-pure: derived from the manifest entry and the (globally
+    consistent) target kind only. Replicated pickled objects record no
+    size and return 0 (configs/schedules in practice — always broadcast
+    territory)."""
     if isinstance(entry, ArrayEntry):
         if not is_replicated(entry) or entry.raw_range is not None:
-            return False
-        return entry_cost_bytes(entry) <= max_bytes
+            return None
+        return entry_cost_bytes(entry)
     if isinstance(entry, ChunkedArrayEntry):
         if not is_replicated(entry):
-            return False
+            return None
         if any(c.tensor.raw_range is not None for c in entry.chunks):
-            return False
-        return sum(entry_cost_bytes(c.tensor) for c in entry.chunks) <= max_bytes
+            return None
+        return sum(entry_cost_bytes(c.tensor) for c in entry.chunks)
     if isinstance(entry, ObjectEntry):
-        # Pickled objects don't record a size in the manifest; replicated
-        # objects are configs/schedules in practice, far below the cap.
-        return is_replicated(entry)
+        return 0 if is_replicated(entry) else None
     if isinstance(entry, ShardedArrayEntry):
         # A sharded SAVE restored onto a fully-replicated target (the
         # serving shape: train sharded, serve replicated) reads every shard
         # on every rank — the same N× redundancy as replicated entries.
         if any(s.tensor.raw_range is not None for s in entry.shards):
-            return False
-        if sum(entry_cost_bytes(s.tensor) for s in entry.shards) > max_bytes:
-            return False
-        return is_fully_replicated_target(live)
-    return False
+            return None
+        if not is_fully_replicated_target(live):
+            return None
+        return sum(entry_cost_bytes(s.tensor) for s in entry.shards)
+    return None
+
+
+def eligible(entry: Entry, live: Any) -> bool:  # spmd-pure
+    """SPMD-pure broadcast eligibility: derived from the manifest entry,
+    env knobs, and the (globally consistent) target kind only."""
+    cost = replicated_read_cost(entry, live)
+    return cost is not None and cost <= knobs.get_broadcast_max_bytes()
+
+
+def select_restore_mode(  # spmd-pure
+    entry: Entry,
+    live: Any,
+    bcast_enabled: bool,
+    swarm_enabled: bool,
+    digests: Optional[Dict[str, object]],
+) -> str:
+    """The restore transport for one entry — ``"direct"`` | ``"bcast"`` |
+    ``"swarm"`` — as a pure function of the manifest entry, knobs, the
+    (globally consistent) target kind, and the snapshot's merged digest
+    sidecars, so every rank selects the identical mode:
+
+    - not replicated (or raw-range/sharded-onto-sharded) → **direct**;
+    - replicated, ≤ ``BCAST_MAX_BYTES`` → **bcast** (single elected reader
+      + store fan-out: one payload key, minimal coordination);
+    - replicated, above the cap, with v2 chunk-grid sidecar records →
+      **swarm** (chunk-granular: every rank fetches a distinct chunk
+      subset from origin and trades the rest peer-to-peer — origin bytes
+      stay ~1× the object at any world size);
+    - anything else → **direct** (the pre-swarm K× cliff, now only for
+      objects the sidecars can't chunk-verify).
+    """
+    cost = replicated_read_cost(entry, live)
+    if cost is None:
+        return "direct"
+    if cost <= knobs.get_broadcast_max_bytes():
+        return "bcast" if bcast_enabled else "direct"
+    if swarm_enabled:
+        from . import swarm as swarm_mod
+
+        if swarm_mod.entry_swarmable(entry, digests):
+            return "swarm"
+    return "direct"
 
 
 def elect_reader(  # spmd-pure
@@ -469,6 +521,7 @@ def run_broadcast(
         try:
             await fetch_assigned()
             obtained: Dict[Tuple[str, Optional[Tuple[int, int]]], Tuple[bytes, str]] = {}
+            per_object = LAST_RESTORE_BCAST["per_object"]
             for item in items:
                 for req in item.reqs:
                     key = (req.path, req.byte_range)
@@ -476,6 +529,14 @@ def run_broadcast(
                         obtained[key] = await obtain(key)
                         pending_count[0] -= 1
                         tracker.note_request_done()
+                        data, how = obtained[key]
+                        rec = per_object.setdefault(
+                            key[0], {"origin_bytes": 0, "peer_bytes": 0}
+                        )
+                        if how == "received":
+                            rec["peer_bytes"] += len(data)
+                        else:  # fetched by this rank or direct fallback
+                            rec["origin_bytes"] += len(data)
                     data, how = obtained[key]
                     if how == "received":
                         telemetry.counter_add("bcast.recv_bytes", len(data))

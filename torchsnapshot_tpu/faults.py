@@ -25,7 +25,16 @@ Spec grammar (rules separated by ``;``, fields by ``,``)::
           | op=<op>[,<field>=<value>...]    # one injection rule
 
     op    = write | read | delete | stream_open | append | commit | abort
-          | link | list | any
+          | link | list | peer_serve | any
+
+    ``peer_serve`` is not a storage op: it fires at the swarm restore's
+    peer-serving point, just before a rank posts a fetched chunk for its
+    peers (``swarm.py``). ``stall`` delays the post past the chunk deadline
+    (driving per-chunk re-election), ``kill`` is peer death mid-serve,
+    ``corrupt`` flips bytes in the POSTED payload only (the serving rank's
+    own copy stays clean — the receiving peer's per-chunk verification must
+    catch it and attribute it to the serving rank), ``fail``/``transient``
+    surface as a failed serve (peers fall back to origin).
     kind  = transient  raise a retryable error (drives cloud_retry)
           | fail       raise a permanent InjectedFault
           | torn       transfer `bytes` bytes, then fail WITHOUT abort
@@ -112,6 +121,7 @@ _OPS = (
     "abort",
     "link",
     "list",
+    "peer_serve",
     "any",
 )
 _KINDS = ("transient", "fail", "torn", "stall", "kill", "corrupt")
@@ -269,9 +279,9 @@ def parse_fault_spec(spec: str) -> FaultPlan:
             raise FaultSpecError(
                 f"kind=torn applies to write/append ops, not {rule.op!r}"
             )
-        if rule.kind == "corrupt" and rule.op not in ("read", "any"):
+        if rule.kind == "corrupt" and rule.op not in ("read", "peer_serve", "any"):
             raise FaultSpecError(
-                f"kind=corrupt applies to read ops, not {rule.op!r}"
+                f"kind=corrupt applies to read/peer_serve ops, not {rule.op!r}"
             )
         if rule.chunk is not None and rule.kind != "corrupt":
             raise FaultSpecError(
@@ -500,6 +510,39 @@ class FaultyStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         await self.inner.close()
+
+    # ------------------------------------------------- swarm peer-serve hook
+    async def inject_peer_serve(self, path: str, payload: bytearray) -> None:
+        """The swarm restore's peer-serving injection point, called with
+        the chunk's POSTED payload copy right before this rank fans the
+        chunk out to its peers. stall/kill/transient/fail behave as at any
+        storage op (a raised fault surfaces as a failed serve); ``corrupt``
+        flips seeded bytes of ``payload`` in place — the serving rank's own
+        buffer stays clean, modeling a serve that rots in flight
+        (NIC/serialization rot), the failure mode per-chunk receipt
+        verification exists to catch and attribute to the serving rank."""
+        act = await self._guard("peer_serve", path)
+        if act is None or act.kind != "corrupt" or not payload:
+            return
+        flips = max(1, act.rule.bytes)
+        for _ in range(flips):
+            payload[self._rng.randrange(len(payload))] ^= 0xFF
+        logger.warning(
+            "FAULT corrupt %d byte(s) in peer-served chunk %s", flips, path
+        )
+
+
+def find_fault_injector(storage) -> Optional[FaultyStoragePlugin]:
+    """Locate the fault wrapper inside a (possibly layered) plugin stack —
+    the swarm restore drives its peer-serving fault points through it.
+    Walks ``inner`` links; None when chaos injection is not installed."""
+    seen = 0
+    while storage is not None and seen < 8:
+        if isinstance(storage, FaultyStoragePlugin):
+            return storage
+        storage = getattr(storage, "inner", None)
+        seen += 1
+    return None
 
 
 class _FaultyWriteStream(StorageWriteStream):

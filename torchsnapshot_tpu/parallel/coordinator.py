@@ -105,6 +105,20 @@ class Coordinator:
             tracer.record("coord.defer_delete", key, checked=False)
         self._posted.append((self._generation, key))
 
+    def defer_delete_many(self, keys: List[str]) -> None:
+        """Bulk :meth:`defer_delete` — one journal record for a whole chunk
+        family (the swarm restore posts one payload key per chunk, far too
+        many to journal individually). Same semantics: local GC bookkeeping
+        of this rank's own posts, asymmetric by design, unchecked."""
+        if not keys:
+            return
+        tracer = active_tracer()
+        if tracer is not None:
+            tracer.record(
+                "coord.defer_delete", f"bulk:{len(keys)}", checked=False
+            )
+        self._posted.extend((self._generation, key) for key in keys)
+
     # -- collectives --------------------------------------------------------
     def barrier(self, timeout_s: Optional[float] = None) -> None:
         if self._world_size == 1:

@@ -27,7 +27,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 _DEFAULT_TIMEOUT_S = 300.0
 
@@ -94,6 +94,20 @@ class Store(abc.ABC):
     def delete(self, key: str) -> None:
         """Best-effort removal of a key (and its counter). Default: no-op."""
 
+    # Bulk ops: the swarm restore path polls MANY chunk keys per round and
+    # GC-deletes whole attempt families at once; stores that can batch
+    # (LocalStore under one lock, TCPStore in one framed round trip)
+    # override these, everything else gets the loop.
+    def try_get_many(self, keys: List[str]) -> List[Optional[bytes]]:
+        """``try_get`` for each key, in order. One logical round trip on
+        stores that batch; the default falls back to per-key calls."""
+        return [self.try_get(k) for k in keys]
+
+    def delete_many(self, keys: List[str]) -> None:
+        """Best-effort bulk removal (keys and their counters)."""
+        for k in keys:
+            self.delete(k)
+
     def prefix(self, p: str) -> "PrefixStore":
         return PrefixStore(p, self)
 
@@ -117,6 +131,12 @@ class PrefixStore(Store):
 
     def delete(self, key: str) -> None:
         self._store.delete(f"{self._prefix}/{key}")
+
+    def try_get_many(self, keys: List[str]) -> List[Optional[bytes]]:
+        return self._store.try_get_many([f"{self._prefix}/{k}" for k in keys])
+
+    def delete_many(self, keys: List[str]) -> None:
+        self._store.delete_many([f"{self._prefix}/{k}" for k in keys])
 
 
 # ---------------------------------------------------------------------------
@@ -162,6 +182,18 @@ class LocalStore(Store):
         with self._cond:
             self._data.pop(key, None)
             self._counters.pop(key, None)
+
+    def try_get_many(self, keys: List[str]) -> List[Optional[bytes]]:
+        _count_op("try_get_many")
+        with self._cond:
+            return [self._data.get(k) for k in keys]
+
+    def delete_many(self, keys: List[str]) -> None:
+        _count_op("delete_many")
+        with self._cond:
+            for k in keys:
+                self._data.pop(k, None)
+                self._counters.pop(k, None)
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +341,14 @@ def _recv_msg(sock: socket.socket) -> Any:
 class _StoreServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # A fleet's worth of clients connects in one burst at restore start —
+    # every rank's executor threads open their lazy per-thread sockets
+    # together (the swarm restore alone fans chunk traffic across several
+    # threads per rank). The socketserver default backlog of 5 overflows
+    # under that burst and the kernel eventually RSTs the half-accepted
+    # connections, which surfaced as spurious mid-restore resets at
+    # world >= 8.
+    request_queue_size = 128
 
     def __init__(self, addr):
         super().__init__(addr, _StoreHandler)
@@ -347,10 +387,22 @@ class _StoreHandler(socketserver.BaseRequestHandler):
                     with server.cond:
                         val = server.data.get(key)
                     _send_msg(self.request, ("ok", val))
+                elif op == "mtry_get":
+                    # Bulk try_get: `arg` is the key list, `key` unused —
+                    # one framed round trip for a whole swarm poll.
+                    with server.cond:
+                        vals = [server.data.get(k) for k in arg]
+                    _send_msg(self.request, ("ok", vals))
                 elif op == "delete":
                     with server.cond:
                         server.data.pop(key, None)
                         server.counters.pop(key, None)
+                    _send_msg(self.request, ("ok", None))
+                elif op == "mdelete":
+                    with server.cond:
+                        for k in arg:
+                            server.data.pop(k, None)
+                            server.counters.pop(k, None)
                     _send_msg(self.request, ("ok", None))
                 elif op == "add":
                     with server.cond:
@@ -423,6 +475,12 @@ class TCPStore(Store):
 
     def delete(self, key: str) -> None:
         self._call("delete", key, None)
+
+    def try_get_many(self, keys: List[str]) -> List[Optional[bytes]]:
+        return self._call("mtry_get", "", list(keys))
+
+    def delete_many(self, keys: List[str]) -> None:
+        self._call("mdelete", "", list(keys))
 
     def shutdown(self) -> None:
         if self._server is not None:

@@ -1408,6 +1408,32 @@ def _read_digest_record(digests: Optional[Dict[str, object]], path: str):
     return rec
 
 
+async def fetch_read_io(
+    storage: StoragePlugin,
+    path: str,
+    byte_range: Optional[Tuple[int, int]],
+    progress: "CollectiveProgress",
+) -> ReadIO:
+    """One storage fetch of ``path`` (optionally ranged), retrying
+    transient local OSErrors through the shared ``cloud_retry`` machinery
+    under the caller's collective-progress window — the single fetch
+    discipline of the read pipeline, shared with the broadcast and swarm
+    restore paths so every origin read in the restore story retries
+    identically. A retried read never appends to a partially-filled
+    buffer."""
+    read_io = ReadIO(path=path, byte_range=byte_range)
+
+    async def attempt() -> None:
+        read_io.buf.seek(0)
+        read_io.buf.truncate(0)
+        await storage.read(read_io)
+
+    await retry_transient(
+        attempt, is_transient_os_error, progress, "read_pipeline"
+    )
+    return read_io
+
+
 def _verify_checker(
     want, byte_range: Optional[Tuple[int, int]]
 ) -> Optional[Callable[[memoryview], Optional[str]]]:
@@ -1481,18 +1507,9 @@ async def execute_read_reqs(
         quarantine_cache = find_read_cache(storage)
 
     async def fetch(req: ReadReq) -> ReadIO:
-        read_io = ReadIO(path=req.path, byte_range=req.byte_range)
-
-        async def attempt() -> None:
-            # A retried read must not append to a partially-filled buffer.
-            read_io.buf.seek(0)
-            read_io.buf.truncate(0)
-            await storage.read(read_io)
-
-        await retry_transient(
-            attempt, is_transient_os_error, read_progress, "read_pipeline"
+        return await fetch_read_io(
+            storage, req.path, req.byte_range, read_progress
         )
-        return read_io
 
     async def read_one(req: ReadReq) -> object:
         read_io = await fetch(req)

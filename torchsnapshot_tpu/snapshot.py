@@ -107,12 +107,63 @@ LAST_SYNC_DRAIN_STATS: Dict[str, float] = {}
 
 # Restore-side accounting of this process's most recent ``restore()``:
 # end-to-end wall seconds, aggregated read-pipeline stats (bytes_read /
-# read_wall_s / requests), and the broadcast-restore record
-# (``bcast.LAST_RESTORE_BCAST``). The restore analogue of the take
+# read_wall_s / requests), the broadcast-restore record
+# (``bcast.LAST_RESTORE_BCAST``), the swarm-restore record
+# (``swarm.LAST_RESTORE_SWARM``), and the origin-vs-peer-vs-cache byte
+# attribution (``attribution``). The restore analogue of the take
 # diagnostics above — bench.py's restore regression gate and the serving
 # benchmark read it without needing a telemetry session. Diagnostics only:
 # overwritten per restore, per process.
 LAST_RESTORE_STATS: Dict[str, Any] = {}
+
+
+def _restore_attribution(
+    bcast_rec: Dict[str, Any],
+    swarm_rec: Dict[str, Any],
+    read_totals: Dict[str, float],
+    storage: Any,
+) -> Dict[str, int]:
+    """Origin-vs-peer-vs-cache byte attribution for one restore — the
+    production-observable form of the serving-path claims ("warm restores
+    read 0 origin bytes", "swarm origin bytes ≈ one snapshot at any K").
+
+    - ``origin_bytes``: bytes THIS rank pulled from origin storage — the
+      broadcast phase's fetched/direct reads, the swarm phase's assigned/
+      re-elected/fallback chunk reads, and the direct read pipeline's
+      fetches minus whatever the read-through cache served locally;
+    - ``peer_bytes``: bytes received from other ranks through the
+      coordinator store (broadcast payloads + swarm chunks);
+    - ``cache_bytes``: bytes served from the local read-through cache
+      (pipeline hits + swarm cache-held chunks).
+
+    Per-object breakdowns live in ``LAST_RESTORE_STATS["bcast"]
+    ["per_object"]`` and ``["swarm"]["per_object"]``."""
+    cache_hit_bytes = 0
+    try:
+        from .storage_plugins.cache import find_read_cache
+
+        cache = find_read_cache(storage)
+        if cache is not None:
+            cache_hit_bytes = int(cache.stats.get("hit_bytes", 0))
+    except Exception:  # noqa: BLE001 - diagnostics never fail a restore
+        pass
+    # The swarm's cache-probe hits are counted inside cache.stats too;
+    # pipeline-side cache bytes are the remainder.
+    swarm_cache = int(swarm_rec.get("cache_bytes", 0))
+    pipeline_cache = max(0, cache_hit_bytes - swarm_cache)
+    pipeline_read = int(read_totals.get("bytes_read", 0))
+    return {
+        "origin_bytes": (
+            int(bcast_rec.get("origin_bytes", 0))
+            + int(swarm_rec.get("origin_bytes", 0))
+            + max(0, pipeline_read - pipeline_cache)
+        ),
+        "peer_bytes": (
+            int(bcast_rec.get("recv_bytes", 0))
+            + int(swarm_rec.get("peer_bytes", 0))
+        ),
+        "cache_bytes": swarm_cache + pipeline_cache,
+    }
 
 
 def _begin_telemetry(
@@ -906,8 +957,10 @@ class Snapshot:
         tm, tm_prev = _begin_telemetry(_telemetry)
         restore_t0 = time.monotonic()
         from . import bcast as bcast_mod
+        from . import swarm as swarm_mod
 
         bcast_mod.reset_diagnostics()
+        swarm_mod.reset_diagnostics()
         LAST_RESTORE_STATS.clear()
         read_totals = {"bytes_read": 0.0, "read_wall_s": 0.0, "requests": 0.0}
         # Before any storage IO: the metadata read below would otherwise
@@ -919,6 +972,12 @@ class Snapshot:
         # world size + knob + the storage plugin's locality flag) so every
         # stateful of this restore — and every rank — agrees on the gate.
         bcast_enabled = knobs.is_broadcast_restore_enabled(
+            coord.get_world_size(), storage
+        )
+        # Swarm restore (chunk-granular peer-to-peer fan-out for replicated
+        # objects above the broadcast cap): same once-per-restore gate
+        # discipline as broadcast, so every stateful and every rank agree.
+        swarm_enabled = knobs.is_swarm_restore_enabled(
             coord.get_world_size(), storage
         )
         # One pool set for every per-stateful read pipeline of this restore
@@ -995,6 +1054,7 @@ class Snapshot:
                             pools=pools,
                             include=include,
                             bcast_enabled=bcast_enabled,
+                            swarm_enabled=swarm_enabled,
                             coord=coord,
                             digests=digest_index,
                         )
@@ -1038,6 +1098,13 @@ class Snapshot:
             LAST_RESTORE_STATS.update(read_totals)
             LAST_RESTORE_STATS["wall_s"] = time.monotonic() - restore_t0
             LAST_RESTORE_STATS["bcast"] = dict(bcast_mod.LAST_RESTORE_BCAST)
+            LAST_RESTORE_STATS["swarm"] = dict(swarm_mod.LAST_RESTORE_SWARM)
+            LAST_RESTORE_STATS["attribution"] = _restore_attribution(
+                bcast_mod.LAST_RESTORE_BCAST,
+                swarm_mod.LAST_RESTORE_SWARM,
+                read_totals,
+                storage,
+            )
         except BaseException as e:
             aborted = _abort_exception(self.path, barrier, rank, phase, e)
             if aborted is e:
@@ -1067,6 +1134,7 @@ class Snapshot:
         pools: Optional[PipelinePools] = None,
         include: Optional[List[str]] = None,
         bcast_enabled: bool = False,
+        swarm_enabled: bool = False,
         coord: Optional[Coordinator] = None,
         digests: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, float]:
@@ -1157,20 +1225,30 @@ class Snapshot:
             _memory_budget_bytes_per_read,
         )
         from . import bcast as bcast_mod
+        from . import swarm as swarm_mod
 
         bcast_items: List["bcast_mod.BroadcastItem"] = []
+        swarm_items: List["swarm_mod.SwarmItem"] = []
         for idx, (logical_path, entry) in enumerate(entries.items()):
             live = live_flattened.get(logical_path)
-            if (
-                bcast_enabled
-                and coord is not None
-                and bcast_mod.eligible(entry, live)
-            ):
-                # Single-reader + broadcast path. Planned with NO budget
-                # sub-read limit so the (path, byte_range) sequence is a
-                # pure function of the entry — identical on every rank,
-                # which the store broadcasts below require. Bounded by the
-                # BCAST_MAX_BYTES eligibility cap.
+            # direct / bcast / swarm, selected SPMD-pure per entry (size,
+            # world gate, knobs, and sidecar chunk grids — identical on
+            # every rank): replicated entries under BCAST_MAX_BYTES ride
+            # the single-reader broadcast, larger chunk-addressable ones
+            # the peer-to-peer swarm, everything else the direct pipeline.
+            mode = bcast_mod.select_restore_mode(
+                entry,
+                live,
+                bcast_enabled and coord is not None,
+                swarm_enabled and coord is not None,
+                digests,
+            )
+            if mode in ("bcast", "swarm"):
+                # Collective path. Planned with NO budget sub-read limit so
+                # the (path, byte_range) sequence is a pure function of the
+                # entry — identical on every rank, which the fenced store
+                # keys below require. Bounded by BCAST_MAX_BYTES (bcast) /
+                # one-object-at-a-time chunk assembly (swarm).
                 reqs, finalize = _prepare_restore_one(
                     logical_path,
                     entry,
@@ -1179,9 +1257,14 @@ class Snapshot:
                     buffer_size_limit_bytes=None,
                     frame_tables=frame_tables,
                 )
-                bcast_items.append(
-                    bcast_mod.BroadcastItem(logical_path, reqs, finalize)
-                )
+                if mode == "bcast":
+                    bcast_items.append(
+                        bcast_mod.BroadcastItem(logical_path, reqs, finalize)
+                    )
+                else:
+                    swarm_items.append(
+                        swarm_mod.SwarmItem(logical_path, reqs, finalize)
+                    )
                 continue
             reqs, finalize = _prepare_restore_one(
                 logical_path,
@@ -1220,6 +1303,20 @@ class Snapshot:
             # consumes + finalizes locally.
             bcast_mod.run_broadcast(
                 bcast_items,
+                storage,
+                coord,
+                event_loop,
+                executor=pools.consuming_executor() if pools else None,
+                digests=digests,
+            )
+
+        if swarm_items:
+            # Swarm phase: chunk-granular fan-out for replicated objects
+            # above the broadcast cap — every rank origin-reads a distinct
+            # chunk subset and trades the rest peer-to-peer, each chunk
+            # verified against the sidecar grid on receipt.
+            swarm_mod.run_swarm(
+                swarm_items,
                 storage,
                 coord,
                 event_loop,

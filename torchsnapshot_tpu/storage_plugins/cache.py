@@ -138,6 +138,11 @@ class CachedStoragePlugin(StoragePlugin):
         # a tight byte budget can otherwise evict a just-renamed entry out
         # from under the reader that is validating it.
         self._pinned: Dict[str, int] = {}
+        # Per-instance byte accounting (the plugin stack is constructed
+        # fresh per take/restore, so these are per-operation): feeds the
+        # restore's origin-vs-peer-vs-cache attribution
+        # (``snapshot.LAST_RESTORE_STATS``) without a telemetry session.
+        self.stats: Dict[str, int] = {"hit_bytes": 0, "miss_bytes": 0}
 
     # -- capability flags proxy the origin ----------------------------------
     @property
@@ -382,6 +387,58 @@ class CachedStoragePlugin(StoragePlugin):
             )
         return removed
 
+    # -- swarm surface -------------------------------------------------------
+    async def try_read_object(self, path: str) -> Optional[bytes]:
+        """The full object's bytes from the LOCAL store only (verified the
+        same way a hit is), or None — never touches the origin. The swarm
+        restore probes this before planning origin fetches: a host that
+        already holds the content serves its assigned chunks to peers from
+        local bytes, reading zero origin bytes. Restricted to digest-known
+        paths: an unvalidated path-keyed entry is not strong enough to
+        seed a fan-out."""
+        entry, expect = self._entry_for(path)
+        if expect is None:
+            return None
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(
+            self._get_executor(),
+            self._read_entry,
+            entry,
+            expect,
+            knobs.is_read_cache_verify_enabled(),
+        )
+        if data is not None:
+            telemetry.counter_add("cache.hits")
+            telemetry.counter_add("cache.hit_bytes", len(data))
+            self.stats["hit_bytes"] += len(data)
+        return data
+
+    async def populate_object(self, path: str, data: bytes) -> None:
+        """Populate ``path``'s cache entry from bytes the caller already
+        holds and has verified — the swarm restore lands each assembled,
+        chunk-verified object here so the NEXT restore on this host reads
+        zero origin AND zero peer bytes. Digest-keyed when the index knows
+        the path (content-addressed across snapshots), else path-keyed.
+        Fail-open like every populate."""
+        entry, _expect = self._entry_for(path)
+        try:
+            with telemetry.span(
+                "storage.cache_populate",
+                cat="storage",
+                path=path,
+                nbytes=len(data),
+            ):
+                await asyncio.get_running_loop().run_in_executor(
+                    self._get_executor(), self._write_entry, entry, bytes(data)
+                )
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "failed to populate read cache for %s (swarm restore "
+                "proceeds; caching disabled for this object)",
+                path,
+                exc_info=True,
+            )
+
     # -- read path -----------------------------------------------------------
     async def read(self, read_io: ReadIO) -> None:
         loop = asyncio.get_running_loop()
@@ -421,6 +478,7 @@ class CachedStoragePlugin(StoragePlugin):
             sliced = data[begin:end]
             telemetry.counter_add("cache.hits")
             telemetry.counter_add("cache.hit_bytes", len(sliced))
+            self.stats["hit_bytes"] += len(sliced)
             read_io.buf.write(sliced)
             return
 
@@ -430,6 +488,7 @@ class CachedStoragePlugin(StoragePlugin):
         if data is not None:
             telemetry.counter_add("cache.hits")
             telemetry.counter_add("cache.hit_bytes", len(data))
+            self.stats["hit_bytes"] += len(data)
             read_io.buf.write(data)
             return
 
@@ -440,6 +499,7 @@ class CachedStoragePlugin(StoragePlugin):
         if pending is not None:
             data = await asyncio.shield(pending)
             telemetry.counter_add("cache.hit_bytes", len(data))
+            self.stats["hit_bytes"] += len(data)
             read_io.buf.write(data)
             return
         fut: asyncio.Future = loop.create_future()
@@ -459,6 +519,7 @@ class CachedStoragePlugin(StoragePlugin):
         finally:
             self._inflight.pop(entry, None)
         telemetry.counter_add("cache.miss_bytes", len(data))
+        self.stats["miss_bytes"] += len(data)
         try:
             with telemetry.span(
                 "storage.cache_populate",

@@ -979,6 +979,77 @@ def override_bcast_reelect_max(value: int):
     return _override_env(_ENV_BCAST_REELECT_MAX, str(value))
 
 
+_ENV_SWARM_RESTORE = "TORCHSNAPSHOT_TPU_SWARM_RESTORE"
+_ENV_SWARM_CHUNK_DEADLINE = "TORCHSNAPSHOT_TPU_SWARM_CHUNK_DEADLINE_S"
+_ENV_SWARM_FANOUT = "TORCHSNAPSHOT_TPU_SWARM_FANOUT"
+
+_DEFAULT_SWARM_CHUNK_DEADLINE_S = 30.0
+_DEFAULT_SWARM_FANOUT = 8
+
+
+def is_swarm_restore_enabled(world_size: int, storage=None) -> bool:
+    """Content-addressed swarm restore for LARGE replicated objects (above
+    ``TORCHSNAPSHOT_TPU_BCAST_MAX_BYTES``, where single-reader broadcast
+    would hold the whole payload in the coordinator store): every rank
+    fetches a distinct subset of the object's v2 hash-chunk grid from
+    origin (assignment spread by the sha1 election order, SPMD-pure) and
+    fills the rest peer-to-peer through the coordinator store, verifying
+    each received chunk against the sidecar tree digests — total origin
+    bytes ≈ one snapshot regardless of fleet size. Requires the snapshot's
+    v2 tree-digest sidecars (chunk-grain records); objects without them
+    fall back to direct per-rank reads.
+
+    Default ``auto``: same gate as broadcast restore — enabled at
+    world > 1 against network/object stores, disabled for local-disk
+    plugins and always at world 1. ``1``/``0`` force (still a no-op at
+    world 1)."""
+    if world_size <= 1:
+        return False
+    val = os.environ.get(_ENV_SWARM_RESTORE, "auto").lower()
+    if val in ("auto", ""):
+        return not bool(getattr(storage, "scales_io_with_local_world", False))
+    return val not in ("0", "false", "off")
+
+
+def get_swarm_chunk_deadline_s() -> float:
+    """How long a swarm peer waits for one chunk from its elected serving
+    rank before declaring that rank dead for the chunk and re-electing the
+    next rank in the sha1 order (default 30 s). Per chunk and per attempt —
+    a slow server posting late still lands under its own attempt fence."""
+    try:
+        return max(
+            0.05,
+            float(
+                os.environ.get(
+                    _ENV_SWARM_CHUNK_DEADLINE,
+                    _DEFAULT_SWARM_CHUNK_DEADLINE_S,
+                )
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_SWARM_CHUNK_DEADLINE_S
+
+
+def get_swarm_fanout() -> int:
+    """Peer-fanout cap: concurrent chunk transfers (origin fetches by this
+    rank plus chunks being served to peers) per swarm object (default 8).
+    Bounds both origin-connection pressure and the host RAM held by
+    in-flight chunk payloads beyond the object buffer itself."""
+    return max(1, _get_int(_ENV_SWARM_FANOUT, _DEFAULT_SWARM_FANOUT))
+
+
+def override_swarm_restore(enabled: bool):
+    return _override_env(_ENV_SWARM_RESTORE, "1" if enabled else "0")
+
+
+def override_swarm_chunk_deadline_s(value: float):
+    return _override_env(_ENV_SWARM_CHUNK_DEADLINE, str(value))
+
+
+def override_swarm_fanout(value: int):
+    return _override_env(_ENV_SWARM_FANOUT, str(value))
+
+
 _ENV_READ_MERGE_GAP = "TORCHSNAPSHOT_TPU_READ_MERGE_GAP_BYTES"
 
 
