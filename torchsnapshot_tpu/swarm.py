@@ -1,0 +1,772 @@
+"""Content-addressed swarm restore: chunk-granular peer-to-peer fan-out.
+
+The broadcast restore (``bcast.py``) collapses a serving fleet's K identical
+origin reads to 1 per replicated object — but it moves each object through
+the coordinator store as ONE payload, so it is capped at
+``TORCHSNAPSHOT_TPU_BCAST_MAX_BYTES`` and large objects fall off a cliff
+back to K× origin reads. This module removes the cliff: for replicated
+objects whose sidecar carries a **v2 tree-digest record** (PR 10 —
+per-chunk crc32/sha256 at a fixed grain under a sha256 root), every rank
+fetches a *distinct* subset of the chunk grid from origin and fills the
+rest peer-to-peer through the coordinator store, torrent-style. Total
+origin bytes stay ≈ one copy of the object regardless of fleet size, and
+the origin read load — like the serve load — spreads across ranks instead
+of concentrating on one elected reader.
+
+Design constraints, and how they are met:
+
+- **SPMD symmetry.** All plan math — mode selection
+  (``bcast.select_restore_mode``), the chunk grid, and the per-chunk server
+  assignment — is a pure function of the manifest entry, knobs, and the
+  snapshot's merged digest sidecars (identical on every rank), so every
+  rank computes the identical plan with zero planning collectives. Chunk
+  ``k`` of an object is served by ``reader_order(path, chunk_extent,
+  world)[attempt]`` — the existing sha1 election order, keyed per chunk so
+  assignments spread across the fleet.
+- **Every received byte is verified.** Each chunk — fetched from origin or
+  received from a peer — is checked against its sidecar per-chunk digest
+  (the chunk list under the v2 root) on receipt, unless
+  ``TORCHSNAPSHOT_TPU_VERIFY_READS=off``. A corrupt origin fetch follows
+  the PR 9 discipline (quarantine the read cache for the path → one
+  re-fetch → :class:`~.scheduler.ReadVerificationError`); a corrupt chunk
+  from a PEER is attributed to the serving rank and healed by one direct
+  verified origin read — one rank's rot never spreads, and never costs
+  more than one extra chunk fetch.
+- **Never less available than direct mode.** A peer that sees neither a
+  payload nor an error marker for a chunk within
+  ``TORCHSNAPSHOT_TPU_SWARM_CHUNK_DEADLINE_S`` re-elects the next rank in
+  the chunk's sha1 order (the new server self-detects via its own expired
+  wait, exactly like broadcast reader re-election); past
+  ``TORCHSNAPSHOT_TPU_BCAST_REELECT_MAX`` re-elections it reads the chunk
+  directly from origin. A server whose origin read fails permanently posts
+  an error marker so peers skip straight to their direct fallback.
+- **Bounded store occupancy.** Chunk payload keys are fenced by a
+  per-restore token, the object index, the chunk index, AND a per-chunk
+  attempt counter. Every rank acks each chunk once it holds the bytes;
+  the LAST acker (store counter == world) deletes the chunk's payload
+  keys eagerly, so the coordinator store holds ~in-flight chunks, not the
+  whole snapshot. Posted keys are also registered with the coordinator's
+  deferred-delete GC as a backstop for keys a late server posts after the
+  eager pass.
+- **Bounded transfers.** ``TORCHSNAPSHOT_TPU_SWARM_FANOUT`` caps the
+  concurrent chunk transfers per rank; objects restore sequentially, so
+  peak host RAM is one object buffer plus the in-flight chunks.
+- **Warm hosts serve from the read cache.** If the content-addressed read
+  cache already holds the object (digest-keyed, verified), the rank serves
+  its assigned chunks from local bytes — zero origin reads — and a fully
+  assembled swarm object is populated back into the cache (digest-keyed),
+  so the next restore on the host reads zero origin AND zero peer bytes.
+
+``LAST_RESTORE_SWARM`` records this process's most recent swarm activity —
+including per-object origin/peer/cache byte attribution and the exact
+``(path, chunk)`` origin reads this rank issued — the surface the serving
+benchmark's "total origin bytes ≤ 1.1× one snapshot at any K" and
+"exactly one origin read per chunk" asserts are built on.
+
+Chaos surface: ``faults.py`` grew the ``peer_serve`` op class — a seeded
+fault fired just before a rank posts a chunk for its peers (stall past the
+chunk deadline, death mid-serve, corruption of the posted copy only) —
+driven by the swarm legs of ``tests/test_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+import uuid
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import hashing, telemetry
+from .io_types import ReadReq, StoragePlugin
+from .manifest import (
+    ArrayEntry,
+    ChunkedArrayEntry,
+    Entry,
+    ShardedArrayEntry,
+)
+from .scheduler import (
+    ReadVerificationError,
+    fetch_read_io,
+)
+from .storage_plugins.cloud_retry import CollectiveProgress
+from .utils import knobs
+
+logger = logging.getLogger(__name__)
+
+# Diagnostics of this process's most recent restore (reset by
+# ``Snapshot.restore`` alongside the broadcast record).
+LAST_RESTORE_SWARM: Dict[str, Any] = {}
+
+# Payload markers, shared shape with bcast: one byte prefixed to the chunk
+# bytes so an error report can ride the same fenced key as a payload.
+_OK = b"O"
+_ERR = b"E"
+
+
+def reset_diagnostics() -> None:
+    LAST_RESTORE_SWARM.clear()
+    LAST_RESTORE_SWARM.update(
+        {
+            "objects": 0,
+            "chunks": 0,
+            "chunks_origin": 0,
+            "chunks_peer": 0,
+            "chunks_cache": 0,
+            "origin_bytes": 0,
+            "peer_bytes": 0,
+            "cache_bytes": 0,
+            "reelections": 0,
+            "direct_fallbacks": 0,
+            "verify_failures": 0,
+            "peer_verify_failures": 0,
+            # [(path, chunk_index)] this rank fetched from ORIGIN storage —
+            # summed across ranks, the swarm bench asserts every chunk
+            # appears exactly once fleet-wide.
+            "origin_reads": [],
+            # Chunks received from a peer whose bytes failed verification,
+            # attributed to the rank that served them:
+            # [{"path", "chunk", "from_rank"}].
+            "peer_corruptions": [],
+            # Chunks received from peers that passed digest verification —
+            # with verification on, always == chunks_peer.
+            "peer_chunks_verified": 0,
+            # path -> {"origin_bytes", "peer_bytes", "cache_bytes"}: the
+            # per-object origin-byte attribution (satellite of the
+            # "origin bytes ≈ one snapshot" claim).
+            "per_object": {},
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plan math — SPMD-pure: manifest entries, knobs, and the (globally
+# consistent) merged digest sidecars only.
+# ---------------------------------------------------------------------------
+
+
+def chunk_grid(  # spmd-pure
+    digests: Optional[Dict[str, object]], path: str
+) -> Optional[Tuple[int, int, Optional[List[str]], Optional[List[int]]]]:
+    """``(size, grain, chunk_shas | None, chunk_crcs | None)`` for a path
+    whose sidecar record carries a usable v2 chunk grid, or None (v1 or
+    missing record — not chunk-addressable). When the record carries both
+    per-chunk shas and a root, the grid is accepted only if the shas
+    actually fold to the recorded root — an internally inconsistent
+    sidecar must not seed a fleet-wide fan-out."""
+    if not digests:
+        return None
+    rec = digests.get(path)
+    info = hashing.record_chunk_info(rec)
+    size = hashing.record_size(rec)
+    if info is None or size is None:
+        return None
+    grain, shas, crcs = info
+    if shas is not None and isinstance(rec, dict):
+        root = rec.get("root")
+        if root and hashing.tree_root(shas) != root:
+            return None
+    return size, grain, shas, crcs
+
+
+def entry_locations(entry: Entry) -> List[str]:  # spmd-pure
+    """The storage paths a replicated-shaped entry reads, in manifest
+    order — the objects a swarm plan is built over."""
+    if isinstance(entry, ArrayEntry):
+        return [entry.location]
+    if isinstance(entry, ChunkedArrayEntry):
+        return [c.tensor.location for c in entry.chunks]
+    if isinstance(entry, ShardedArrayEntry):
+        return [s.tensor.location for s in entry.shards]
+    return []
+
+
+def entry_swarmable(  # spmd-pure
+    entry: Entry, digests: Optional[Dict[str, object]]
+) -> bool:
+    """Whether every storage object this entry reads carries a v2
+    chunk-grid sidecar record — the precondition for chunk-granular
+    fetch assignment and per-chunk receipt verification."""
+    locations = entry_locations(entry)
+    if not locations:
+        return False
+    return all(chunk_grid(digests, p) is not None for p in locations)
+
+
+class ObjectPlan:
+    """One swarmed storage object's deterministic chunk plan: extents from
+    the sidecar grid, and per-chunk server orders from the sha1 election
+    order (identical on every rank)."""
+
+    __slots__ = ("path", "size", "grain", "shas", "crcs", "extents", "orders")
+
+    def __init__(
+        self,
+        path: str,
+        size: int,
+        grain: int,
+        shas: Optional[List[str]],
+        crcs: Optional[List[int]],
+        extents: List[Tuple[int, int]],
+        orders: List[List[int]],
+    ) -> None:
+        self.path = path
+        self.size = size
+        self.grain = grain
+        self.shas = shas
+        self.crcs = crcs
+        self.extents = extents
+        self.orders = orders
+
+
+def plan_objects(  # spmd-pure
+    paths: List[str], digests: Optional[Dict[str, object]], world: int
+) -> List[ObjectPlan]:
+    """The full swarm plan for a deterministic path sequence. Pure: every
+    rank passes the identical ``paths`` (manifest order) and ``digests``
+    (merged sidecars), so all ranks hold byte-identical plans — the
+    invariant the fenced store keys below rest on."""
+    from .bcast import reader_order
+
+    plans: List[ObjectPlan] = []
+    for path in paths:
+        grid = chunk_grid(digests, path)
+        if grid is None:
+            # Callers gate on entry_swarmable; a missing grid here is a
+            # caller bug, surfaced loudly rather than silently divergent.
+            raise ValueError(f"swarm-planned path has no chunk grid: {path}")
+        size, grain, shas, crcs = grid
+        extents = hashing.chunk_extents(size, grain)
+        orders = [reader_order(path, ext, world) for ext in extents]
+        plans.append(ObjectPlan(path, size, grain, shas, crcs, extents, orders))
+    return plans
+
+
+def chunk_check(
+    data, shas: Optional[List[str]], crcs: Optional[List[int]], k: int,
+    extent: Tuple[int, int],
+) -> Optional[str]:
+    """Verify one chunk's bytes against its recorded digest (sha256 when
+    the sidecar has per-chunk shas, else crc32). Returns a mismatch
+    description or None. Runs on an executor thread for large chunks."""
+    want_len = extent[1] - extent[0]
+    mv = memoryview(data)
+    if mv.nbytes != want_len:
+        return f"chunk {k}: {mv.nbytes} bytes != expected {want_len}"
+    if shas is not None:
+        got = hashlib.sha256(mv).hexdigest()
+        if got != shas[k]:
+            return f"chunk {k}: sha256 {got} != recorded {shas[k]}"
+        return None
+    if crcs is not None:
+        got_crc = zlib.crc32(mv)
+        if got_crc != crcs[k]:
+            return f"chunk {k}: crc32 {got_crc} != recorded {crcs[k]}"
+    return None
+
+
+class SwarmItem:
+    """One swarm-eligible entry's planned reads + finalizer (the swarm
+    analogue of :class:`~.bcast.BroadcastItem`). ``reqs`` may carry byte
+    ranges — they are served as slices of the assembled object."""
+
+    __slots__ = ("logical_path", "reqs", "finalize")
+
+    def __init__(
+        self,
+        logical_path: str,
+        reqs: List[ReadReq],
+        finalize: Optional[Callable[[], None]],
+    ) -> None:
+        self.logical_path = logical_path
+        self.reqs = reqs
+        self.finalize = finalize
+
+
+class _SwarmSession:
+    """One ``run_swarm`` call's store namespace + fetch/verify plumbing.
+
+    Keys live under ``swarmx/<token>/<obj>/<chunk>/<attempt>`` (token
+    broadcast from rank 0 once per session — generation fencing across
+    restores; the attempt counter fences per-chunk re-elections). Ack
+    counters live beside them (``ack/<obj>/<chunk>``): the last rank to
+    ack a chunk deletes its payload keys, keeping store occupancy at
+    ~in-flight chunks."""
+
+    def __init__(self, coord, storage: StoragePlugin, executor, verify) -> None:
+        self.coord = coord
+        self.storage = storage
+        self.executor = executor
+        self.verify = verify
+        self.rank = coord.get_rank()
+        self.world = coord.get_world_size()
+        token = coord.broadcast_object(
+            uuid.uuid4().hex[:12] if self.rank == 0 else None, src=0
+        )
+        self.prefix = f"swarmx/{token}"
+        self.ns = coord.store.prefix(self.prefix)
+        # Every key this rank posted (full store keys): registered with the
+        # coordinator's deferred-delete GC after the drive as the backstop
+        # for keys the eager ack-GC missed (late posts past re-election).
+        self.posted: List[str] = []
+        self.progress = CollectiveProgress()
+        self._quarantine_cache = None
+        self._read_cache = None
+        from .storage_plugins.cache import find_read_cache
+
+        self._read_cache = find_read_cache(storage)
+        if self.verify:
+            self._quarantine_cache = self._read_cache
+        from .faults import find_fault_injector
+
+        self._injector = find_fault_injector(storage)
+
+    # ------------------------------------------------------------ store I/O
+    async def _store_call(self, fn, *args):
+        """Blocking store ops off the event loop, so the stall watchdog
+        (and concurrent fetches) keep running during a slow round trip."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self.executor, fn, *args
+        )
+
+    @staticmethod
+    def _key(obj: int, k: int, attempt: int) -> str:
+        return f"{obj}/{k}/{attempt}"
+
+    async def post(self, obj: int, k: int, attempt: int, payload: bytes) -> None:
+        key = self._key(obj, k, attempt)
+        await self._store_call(self.ns.set, key, payload)
+        self.posted.append(f"{self.prefix}/{key}")
+
+    async def try_get_many(
+        self, keys: List[str]
+    ) -> List[Optional[bytes]]:
+        return await self._store_call(self.ns.try_get_many, keys)
+
+    async def ack(self, obj: int, k: int, max_attempts: int) -> None:
+        """Acknowledge that this rank holds chunk ``(obj, k)`` and will
+        never read its payload keys again. The LAST acker (counter ==
+        world) eagerly deletes the chunk's payload keys and the counter —
+        the swarm's store-side GC."""
+        n = await self._store_call(self.ns.add, f"ack/{obj}/{k}", 1)
+        if n >= self.world:
+            keys = [self._key(obj, k, a) for a in range(max_attempts)]
+            keys.append(f"ack/{obj}/{k}")
+            await self._store_call(self.ns.delete_many, keys)
+
+    # ------------------------------------------------------- verified fetch
+    async def fetch_chunk_verified(self, plan: ObjectPlan, k: int) -> bytes:
+        """One ORIGIN read of chunk ``k`` (ranged, through the shared
+        retry discipline), digest-verified against the sidecar grid, with
+        one quarantine + re-fetch on mismatch — the PR 9 discipline at
+        chunk granularity. Raises :class:`ReadVerificationError` on a
+        second mismatch."""
+        loop = asyncio.get_running_loop()
+        extent = plan.extents[k]
+
+        async def fetch_once() -> bytes:
+            read_io = await fetch_read_io(
+                self.storage, plan.path, extent, self.progress
+            )
+            return read_io.buf.getvalue()
+
+        data = await fetch_once()
+        if not self.verify:
+            return data
+        problem = await loop.run_in_executor(
+            self.executor, chunk_check, data, plan.shas, plan.crcs, k, extent
+        )
+        if problem is None:
+            return data
+        telemetry.counter_add("swarm.verify_failures")
+        LAST_RESTORE_SWARM["verify_failures"] += 1
+        logger.warning(
+            "swarm origin read of %s failed chunk verification (%s); "
+            "quarantining cache entries and re-fetching once",
+            plan.path,
+            problem,
+        )
+        if self._quarantine_cache is not None:
+            await loop.run_in_executor(
+                self.executor,
+                self._quarantine_cache.quarantine_path,
+                plan.path,
+            )
+        data = await fetch_once()
+        problem = await loop.run_in_executor(
+            self.executor, chunk_check, data, plan.shas, plan.crcs, k, extent
+        )
+        if problem is not None:
+            telemetry.counter_add("swarm.verify_failures")
+            LAST_RESTORE_SWARM["verify_failures"] += 1
+            raise ReadVerificationError(
+                f"swarm read of {plan.path} failed chunk verification twice "
+                f"({problem}); persistent corruption at the source — "
+                "aborting instead of fanning bad bytes out to the fleet"
+            )
+        return data
+
+    async def cache_probe(self, plan: ObjectPlan) -> Optional[bytes]:
+        """The whole object from the local read cache (verified), or None."""
+        if self._read_cache is None:
+            return None
+        data = await self._read_cache.try_read_object(plan.path)
+        if data is not None and len(data) == plan.size:
+            return data
+        return None
+
+    async def cache_populate(self, plan: ObjectPlan, buf: bytearray) -> None:
+        if self._read_cache is not None:
+            await self._read_cache.populate_object(plan.path, bytes(buf))
+
+    async def peer_serve_fault(self, plan: ObjectPlan, k: int, payload: bytearray) -> None:
+        """The chaos hook: drive the ``peer_serve`` fault point (if a
+        fault injector wraps the plugin stack) against the posted copy."""
+        if self._injector is not None:
+            await self._injector.inject_peer_serve(
+                f"{plan.path}#chunk{k}", payload
+            )
+
+
+def run_swarm(
+    items: List[SwarmItem],
+    storage: StoragePlugin,
+    coord,
+    event_loop: asyncio.AbstractEventLoop,
+    executor=None,
+    digests: Optional[Dict[str, object]] = None,
+) -> None:
+    """Execute the swarm phase for one stateful's eligible entries.
+
+    Called at the same program point on every rank with an identical
+    ``items`` sequence (SPMD). Objects restore sequentially (bounding host
+    RAM to one object buffer + in-flight chunks); within an object, this
+    rank's assigned chunks fetch from origin concurrently (capped by
+    ``TORCHSNAPSHOT_TPU_SWARM_FANOUT``) and post for peers the moment they
+    land, while the wanted chunks fill from peers' fenced store keys with
+    per-chunk deadline / re-election / direct-origin fallback."""
+    if not items:
+        return
+    if not LAST_RESTORE_SWARM:
+        reset_diagnostics()
+    rank = coord.get_rank()
+    world = coord.get_world_size()
+    verify = knobs.get_verify_reads_mode() != "off" and bool(digests)
+    session = _SwarmSession(coord, storage, executor, verify)
+
+    # Deterministic (identical on every rank) object order; the index IS
+    # part of the store-key fence.
+    paths: List[str] = []
+    for item in items:
+        for req in item.reqs:
+            if req.path not in paths:
+                paths.append(req.path)
+    plans = plan_objects(paths, digests, world)
+    path_idx = {p.path: i for i, p in enumerate(plans)}
+
+    # Item completion: finalize an item the moment its last req consumed.
+    item_pending = [len(item.reqs) for item in items]
+    # path -> [(item_index, req)] mapping for delivery.
+    deliveries: Dict[str, List[Tuple[int, ReadReq]]] = {}
+    for i, item in enumerate(items):
+        for req in item.reqs:
+            deliveries.setdefault(req.path, []).append((i, req))
+
+    deadline_s = knobs.get_swarm_chunk_deadline_s()
+    fanout = knobs.get_swarm_fanout()
+    max_attempts = 1 + min(knobs.get_bcast_reelect_max(), world - 1)
+    poll_s = max(0.01, min(0.05, deadline_s / 10.0))
+
+    total_chunks = sum(len(p.extents) for p in plans)
+    tracker = telemetry.ProgressTracker()
+    tracker.set_totals(requests=total_chunks, bytes_=0)
+    pending_count = [total_chunks]
+    per_object = LAST_RESTORE_SWARM["per_object"]
+
+    def _attr(path: str) -> Dict[str, int]:
+        return per_object.setdefault(
+            path, {"origin_bytes": 0, "peer_bytes": 0, "cache_bytes": 0}
+        )
+
+    def _note_chunk(path: str, kind: str, nbytes: int) -> None:
+        _attr(path)[f"{kind}_bytes"] += nbytes
+        LAST_RESTORE_SWARM[f"{kind}_bytes"] += nbytes
+        LAST_RESTORE_SWARM[f"chunks_{kind}"] += 1
+        telemetry.counter_add(f"swarm.chunks_{kind}")
+        telemetry.counter_add(f"swarm.{kind}_bytes", nbytes)
+        tracker.note_staged(nbytes)
+        tracker.note_request_done()
+        pending_count[0] -= 1
+
+    async def origin_fetch(plan: ObjectPlan, obj: int, k: int) -> bytes:
+        """One verified origin chunk read, recorded as such."""
+        data = await session.fetch_chunk_verified(plan, k)
+        LAST_RESTORE_SWARM["origin_reads"].append((plan.path, k))
+        _note_chunk(plan.path, "origin", len(data))
+        return data
+
+    async def restore_object(plan: ObjectPlan, obj: int) -> None:
+        n = len(plan.extents)
+        buf = bytearray(plan.size)
+        have = [False] * n
+
+        # Warm-host shortcut: the read cache already holds the verified
+        # content — every chunk is local. This rank still SERVES its
+        # assigned chunks below (peers must never wait on a cache-hit
+        # rank), it just reads zero origin bytes doing so. Per-rank cache
+        # state never changes the collective plan: serves and acks are
+        # identical either way.
+        cached = await session.cache_probe(plan)
+        if cached is not None:
+            buf[:] = cached
+            have = [True] * n
+            for k in range(n):
+                _note_chunk(plan.path, "cache", plan.extents[k][1] - plan.extents[k][0])
+
+        assigned = [k for k in range(n) if plan.orders[k][0] == rank]
+        sem = asyncio.Semaphore(fanout)
+        acked = set()
+
+        async def ack_once(k: int) -> None:
+            if k not in acked:
+                acked.add(k)
+                await session.ack(obj, k, max_attempts)
+
+        async def serve_chunk(k: int) -> None:
+            async with sem:
+                try:
+                    if have[k]:
+                        b, e = plan.extents[k]
+                        data = bytes(buf[b:e])
+                    else:
+                        data = await origin_fetch(plan, obj, k)
+                        b, e = plan.extents[k]
+                        buf[b:e] = data
+                        have[k] = True
+                    payload = bytearray(data)
+                    await session.peer_serve_fault(plan, k, payload)
+                    await session.post(obj, k, 0, _OK + bytes(payload))
+                except ReadVerificationError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - reported to peers
+                    # Peers skip straight to their direct fallback instead
+                    # of waiting out the chunk deadline; if this rank still
+                    # lacks the chunk it retries direct below.
+                    logger.warning(
+                        "swarm server failed to serve chunk %d of %s: %r; "
+                        "posting error marker",
+                        k,
+                        plan.path,
+                        e,
+                    )
+                    await session.post(obj, k, 0, _ERR + repr(e).encode())
+
+        await asyncio.gather(*(serve_chunk(k) for k in assigned))
+        for k in assigned:
+            if have[k]:
+                await ack_once(k)
+
+        # Peer-to-peer fill of everything this rank doesn't hold yet
+        # (wanted chunks, plus any assigned chunk whose serve failed).
+        wanted = [k for k in range(n) if not have[k]]
+        attempt = {k: 0 for k in wanted}
+        deadline = {k: time.monotonic() + deadline_s for k in wanted}
+
+        async def take_direct(k: int, why: str) -> None:
+            telemetry.counter_add("swarm.direct_fallbacks")
+            LAST_RESTORE_SWARM["direct_fallbacks"] += 1
+            logger.warning(
+                "swarm chunk %d of %s: %s; falling back to a direct "
+                "origin read",
+                k,
+                plan.path,
+                why,
+            )
+            data = await origin_fetch(plan, obj, k)
+            b, e = plan.extents[k]
+            buf[b:e] = data
+            have[k] = True
+
+        async def heal_from_origin(k: int, served_by: int, problem: str) -> None:
+            """A peer served corrupt bytes: attribute, then one verified
+            direct origin read (whose own discipline allows one more
+            re-fetch before ReadVerificationError)."""
+            telemetry.counter_add("swarm.verify_failures")
+            LAST_RESTORE_SWARM["peer_verify_failures"] += 1
+            LAST_RESTORE_SWARM["peer_corruptions"].append(
+                {"path": plan.path, "chunk": k, "from_rank": served_by}
+            )
+            logger.warning(
+                "swarm chunk %d of %s received from rank %d failed digest "
+                "verification (%s); healing from a direct origin read",
+                k,
+                plan.path,
+                served_by,
+                problem,
+            )
+            data = await origin_fetch(plan, obj, k)
+            b, e = plan.extents[k]
+            buf[b:e] = data
+            have[k] = True
+
+        loop = asyncio.get_running_loop()
+        while wanted:
+            served_now: List[int] = []
+            for k in list(wanted):
+                server = plan.orders[k][attempt[k]]
+                if server == rank:
+                    # Re-elected (or this rank's attempt-0 serve failed):
+                    # serve the chunk under THIS attempt's fenced key.
+                    try:
+                        data = await origin_fetch(plan, obj, k)
+                    except ReadVerificationError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - reported
+                        await session.post(
+                            obj, k, attempt[k], _ERR + repr(e).encode()
+                        )
+                        raise
+                    b, e = plan.extents[k]
+                    buf[b:e] = data
+                    have[k] = True
+                    payload = bytearray(data)
+                    await session.peer_serve_fault(plan, k, payload)
+                    await session.post(obj, k, attempt[k], _OK + bytes(payload))
+                    served_now.append(k)
+            for k in served_now:
+                wanted.remove(k)
+                await ack_once(k)
+            if not wanted:
+                break
+            keys = [session._key(obj, k, attempt[k]) for k in wanted]
+            payloads = await session.try_get_many(keys)
+            now = time.monotonic()
+            for k, payload in list(zip(list(wanted), payloads)):
+                if payload is None:
+                    if now < deadline[k]:
+                        continue
+                    if attempt[k] + 1 < max_attempts:
+                        telemetry.counter_add("swarm.reelections")
+                        LAST_RESTORE_SWARM["reelections"] += 1
+                        logger.warning(
+                            "swarm server rank %d missed the %.1fs deadline "
+                            "for chunk %d of %s; re-electing rank %d "
+                            "(attempt %d)",
+                            plan.orders[k][attempt[k]],
+                            deadline_s,
+                            k,
+                            plan.path,
+                            plan.orders[k][attempt[k] + 1],
+                            attempt[k] + 1,
+                        )
+                        attempt[k] += 1
+                        deadline[k] = now + deadline_s
+                    else:
+                        wanted.remove(k)
+                        await take_direct(k, "re-election budget exhausted")
+                        await ack_once(k)
+                    continue
+                wanted.remove(k)
+                if payload[:1] == _ERR:
+                    await take_direct(
+                        k,
+                        "server rank %d reported a failed read (%s)"
+                        % (
+                            plan.orders[k][attempt[k]],
+                            payload[1:].decode(errors="replace"),
+                        ),
+                    )
+                    await ack_once(k)
+                    continue
+                data = payload[1:]
+                problem = None
+                if verify:
+                    problem = await loop.run_in_executor(
+                        executor,
+                        chunk_check,
+                        data,
+                        plan.shas,
+                        plan.crcs,
+                        k,
+                        plan.extents[k],
+                    )
+                if problem is not None:
+                    await heal_from_origin(
+                        k, plan.orders[k][attempt[k]], problem
+                    )
+                else:
+                    b, e = plan.extents[k]
+                    buf[b:e] = data
+                    have[k] = True
+                    if verify:
+                        LAST_RESTORE_SWARM["peer_chunks_verified"] += 1
+                    _note_chunk(plan.path, "peer", len(data))
+                await ack_once(k)
+            if wanted:
+                await asyncio.sleep(poll_s)
+
+        # Cache-held chunks this rank neither served nor waited for still
+        # need their ack — every rank acks every chunk exactly once, so the
+        # LAST acker can GC the chunk's payload keys eagerly.
+        for k in range(n):
+            await ack_once(k)
+
+        # Assembled: land it in the read cache (digest-keyed — the next
+        # restore on this host reads zero origin AND zero peer bytes),
+        # then feed the consumers and finalize completed items.
+        await session.cache_populate(plan, buf)
+        view = memoryview(buf)
+        for item_index, req in deliveries.get(plan.path, []):
+            if req.byte_range is not None:
+                b, e = req.byte_range
+                await req.buffer_consumer.consume_buffer(view[b:e], executor)
+            else:
+                await req.buffer_consumer.consume_buffer(view, executor)
+            item_pending[item_index] -= 1
+            if item_pending[item_index] == 0:
+                finalize = items[item_index].finalize
+                if finalize is not None:
+                    finalize()
+
+    async def drive() -> None:
+        watchdog_task = None
+        warn_s = knobs.get_stall_warn_s()
+        if warn_s > 0:
+            watchdog = telemetry.StallWatchdog(
+                tracker,
+                warn_s,
+                occupancy=lambda: {"swarm_wait": pending_count[0]},
+                rank=rank,
+                on_fire=lambda: telemetry.counter_add(
+                    "scheduler.stall_warnings", 1
+                ),
+            )
+            watchdog_task = asyncio.ensure_future(watchdog.run())
+        try:
+            for obj, plan in enumerate(plans):
+                await restore_object(plan, obj)
+        finally:
+            if watchdog_task is not None:
+                watchdog_task.cancel()
+                await asyncio.gather(watchdog_task, return_exceptions=True)
+
+    telemetry.counter_add("swarm.objects", len(plans))
+    telemetry.counter_add("swarm.chunks", total_chunks)
+    LAST_RESTORE_SWARM["objects"] += len(plans)
+    LAST_RESTORE_SWARM["chunks"] += total_chunks
+    with telemetry.span(
+        "swarm.restore",
+        cat="restore",
+        objects=len(plans),
+        chunks=total_chunks,
+        world=world,
+    ):
+        try:
+            event_loop.run_until_complete(drive())
+        finally:
+            # GC backstop for payload keys the eager ack pass missed (late
+            # posts past a re-election): reclaimed after the restore's
+            # final full-world barrier, like any collective key.
+            coord.defer_delete_many(session.posted)
