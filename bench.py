@@ -29,6 +29,7 @@ Prints ONE JSON line on stdout; everything else goes to stderr.
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -124,6 +125,8 @@ def regression_gate(
     restore_s: float = 0.0,
     stage_hash_s: float = 0.0,
     link_probe: dict = None,
+    reshard_wall_s: float = 0.0,
+    reshard_ratio: float = 0.0,
 ) -> dict:
     """Fail-soft regression gate: compare this run's drain wall,
     drain_vs_link, restore wall, AND drain hash time (``stage_hash_s`` —
@@ -147,11 +150,18 @@ def regression_gate(
     Priors that predate the probe record can't prove comparability and are
     excluded from the ratio comparison (their drain/restore/hash walls
     still gate). A host change can therefore neither fake a vs-link
-    regression nor mask one."""
+    regression nor mask one.
+
+    The reshard surface gates the same way: ``reshard_wall_s`` (the reshard
+    matrix's slowest cell) is host-dependent and compares only against
+    priors with a matching non-degenerate link-probe fingerprint, while
+    ``reshard_ratio`` (origin bytes / theoretical overlap bytes — the
+    minimal-byte claim itself) is host-INDEPENDENT and gates against every
+    prior that recorded one."""
     try:
         return _regression_gate_impl(
             size_gb, drain_s, drain_vs_link, restore_s, stage_hash_s,
-            link_probe or {},
+            link_probe or {}, reshard_wall_s, reshard_ratio,
         )
     except Exception as e:  # pragma: no cover - the gate is fail-soft
         log(f"WARNING: bench regression gate errored ({e!r}); skipping")
@@ -165,6 +175,8 @@ def _regression_gate_impl(
     restore_s: float,
     stage_hash_s: float,
     link_probe: dict,
+    reshard_wall_s: float = 0.0,
+    reshard_ratio: float = 0.0,
 ) -> dict:
     import glob
 
@@ -176,6 +188,7 @@ def _regression_gate_impl(
             det = (rec.get("parsed") or {}).get("detail") or {}
             if abs(float(det.get("size_gb", -1.0)) - size_gb) > 0.05:
                 continue  # different workload: not comparable
+            reshard = det.get("reshard") or {}
             priors.append(
                 (
                     path,
@@ -188,6 +201,8 @@ def _regression_gate_impl(
                         )
                     ),
                     det.get("link_probe") or {},
+                    float(reshard.get("reshard_wall_s_max", 0.0)),
+                    float(reshard.get("origin_ratio_worst", 0.0)),
                 )
             )
         except Exception:
@@ -268,6 +283,34 @@ def _regression_gate_impl(
             f"prior {best_hash_s:.2f}s — hashing is creeping back onto the "
             "drain's critical path"
         )
+    # Reshard wall: host-dependent, like-for-like probe fingerprints only
+    # (the same discipline as drain_vs_link — a host change must not fake
+    # or mask a reshard regression).
+    reshard_wall_priors = [p[6] for p in link_comparable if p[6] > 0]
+    best_reshard_wall = min(reshard_wall_priors) if reshard_wall_priors else 0.0
+    if (
+        reshard_wall_s > 0
+        and best_reshard_wall > 0
+        and reshard_wall_s > best_reshard_wall * 1.10
+    ):
+        problems.append(
+            f"reshard wall {reshard_wall_s:.2f}s is >10% over the best "
+            f"like-for-like prior {best_reshard_wall:.2f}s"
+        )
+    # Origin-byte ratio: host-independent (pure byte accounting) — gates
+    # against every prior that recorded one, plus the absolute 1.1× target.
+    ratio_priors = [p[7] for p in priors if p[7] > 0]
+    best_ratio = min(ratio_priors) if ratio_priors else 0.0
+    if reshard_ratio > 1.1:
+        problems.append(
+            f"reshard origin-byte ratio {reshard_ratio:.3f}× exceeds the "
+            "1.1× theoretical-overlap target — the reshard is over-fetching"
+        )
+    elif best_ratio > 0 and reshard_ratio > best_ratio + 0.02:
+        problems.append(
+            f"reshard origin-byte ratio {reshard_ratio:.3f}× regressed from "
+            f"the best prior {best_ratio:.3f}×"
+        )
     for p in problems:
         log(f"WARNING: bench regression gate: {p}")
     out = {
@@ -278,6 +321,8 @@ def _regression_gate_impl(
         "best_prior_drain_vs_link": round(best_vs_link, 2),
         "best_prior_restore_s": round(best_restore_s, 2),
         "best_prior_stage_hash_s": round(best_hash_s, 2),
+        "best_prior_reshard_wall_s": round(best_reshard_wall, 2),
+        "best_prior_reshard_ratio": round(best_ratio, 3),
         "problems": problems,
     }
     if link_note:
@@ -701,11 +746,47 @@ def main() -> None:
                 restore_record[k] = round(float(v), 4)
         log(f"full restore: {restore_record}")
 
+        # ---- elastic reshard matrix (benchmarks/reshard): N→M restores
+        # across mesh shapes / axis orders / replication, bit-exact, with
+        # origin bytes accounted against the theoretical overlap bytes
+        # (target ≤ 1.1×) and origin/peer/cache attribution per cell.
+        # Fail-soft: the drain trajectory must be written even if the
+        # reshard harness can't run on this host.
+        reshard_record = None
+        try:
+            renv = dict(os.environ)
+            renv.setdefault("JAX_PLATFORMS", "cpu")
+            renv.setdefault("RESHARD_BENCH_MB", "64")
+            renv.setdefault("RESHARD_BENCH_FLEET_KS", "2")
+            renv.setdefault("RESHARD_BENCH_FLEET_MB", "8")
+            proc = subprocess.run(
+                [sys.executable, "benchmarks/reshard/main.py"],
+                env=renv,
+                capture_output=True,
+                text=True,
+                timeout=1800,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-1500:])
+            parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+            det = parsed["detail"]
+            reshard_record = {
+                "origin_ratio_worst": parsed["value"],
+                "reshard_wall_s_max": det["reshard_wall_s_max"],
+                "reshard_gbps_min": det["reshard_gbps_min"],
+                "cells": det["cells"],
+                "fleet": det["fleet"],
+            }
+            log(f"reshard matrix: {reshard_record}")
+        except Exception as e:  # fail-soft by design
+            log(f"WARNING: reshard bench failed ({e!r}); recorded as absent")
+
         # ---- fail-soft regression gate vs the best prior round on this
         # workload (same size_gb): drain wall, drain_vs_link, restore wall,
-        # and drain hash time must not silently regress the way rounds
-        # 2→5 did. An empty trajectory reports no_prior loudly; the round
-        # artifact is written either way.
+        # drain hash time, reshard wall, and the reshard origin-byte ratio
+        # must not silently regress the way rounds 2→5 did. An empty
+        # trajectory reports no_prior loudly; the round artifact is written
+        # either way.
         gate = regression_gate(
             round(gb, 2),
             drain_s,
@@ -713,6 +794,12 @@ def main() -> None:
             restore_s,
             stage_hash_s=stage_breakdown.get("stage_hash_s", 0.0),
             link_probe=link_probe,
+            reshard_wall_s=(
+                reshard_record["reshard_wall_s_max"] if reshard_record else 0.0
+            ),
+            reshard_ratio=(
+                reshard_record["origin_ratio_worst"] if reshard_record else 0.0
+            ),
         )
         log(f"regression gate: {gate}")
 
@@ -748,6 +835,7 @@ def main() -> None:
                         "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
                         "restore_bit_exact": ok,
                         "restore": restore_record,
+                        "reshard": reshard_record,
                         "telemetry": telemetry_summary,
                         # Environment fingerprint: every TORCHSNAPSHOT_TPU_*
                         # knob in effect, plus an explicit record that fault

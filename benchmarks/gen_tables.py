@@ -74,6 +74,67 @@ def render_headline_table(src: str, bench: dict) -> str:
     return "\n".join(lines)
 
 
+def newest_multichip() -> tuple[str, dict] | None:
+    """The newest MULTICHIP_r*.json that carries a drain-scaling curve
+    (rounds 1–5 were pass/fail smokes with no curve — skipped)."""
+    best = None
+    for path in glob.glob(os.path.join(ROOT, "MULTICHIP_r*.json")):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        if not (rec.get("drain_scaling") or {}).get("detail", {}).get("curve"):
+            continue
+        if best is None or int(m.group(1)) > best[0]:
+            best = (int(m.group(1)), os.path.basename(path), rec)
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def render_multichip_table(src: str, rec: dict) -> str:
+    curve = rec["drain_scaling"]["detail"]["curve"]
+    lines = [
+        f"Per-device drain scaling + elastic reshard (`benchmarks/multichip/`"
+        f" + `benchmarks/reshard/`, regenerated from `{src}`; do not edit by "
+        "hand, run `python benchmarks/gen_tables.py`):",
+        "",
+        "| Devices | Drain GB/s | stage_busy s | io_busy s |",
+        "|---|---|---|---|",
+    ]
+    for c in curve:
+        lines.append(
+            f"| {c['devices']} | {c['drain_gbps']:.3f} | "
+            f"{c['stage_busy_s']:.2f} | {c['io_busy_s']:.2f} |"
+        )
+    reshard = rec.get("reshard") or {}
+    det = reshard.get("detail") or {}
+    if det.get("cells"):
+        lines += [
+            "",
+            "| Reshard cell | GB/s | origin / theoretical-overlap bytes |",
+            "|---|---|---|",
+        ]
+        for c in det["cells"]:
+            lines.append(
+                f"| {c['cell']} | {c['reshard_gbps']:.3f} | "
+                f"**{c['origin_ratio']:.2f}×** (bit-exact) |"
+            )
+    for f in det.get("fleet") or []:
+        lines.append(
+            f"| fleet K={f['k']} (replicated overlap) | — | "
+            f"**{f['origin_ratio_vs_one_payload']:.2f}×** one payload, "
+            f"every chunk origin-fetched once fleet-wide |"
+        )
+    if rec.get("host_note"):
+        lines += ["", f"*{rec['host_note']}*"]
+    return "\n".join(lines)
+
+
 def render_readme_bullet(src: str, bench: dict) -> str:
     parsed = bench["parsed"]
     d = parsed["detail"]
@@ -121,6 +182,15 @@ def main() -> None:
             render_readme_bullet(src, bench),
         ),
     ]
+    mc = newest_multichip()
+    if mc is not None:
+        targets.append(
+            (
+                os.path.join(ROOT, "benchmarks", "README.md"),
+                "multichip-scaling",
+                render_multichip_table(mc[0], mc[1]),
+            )
+        )
     stale = []
     for path, tag, payload in targets:
         with open(path) as f:

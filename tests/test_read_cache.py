@@ -403,3 +403,181 @@ def test_quarantine_path_removes_digest_and_path_entries(tmp_path):
     assert inner.reads == before + 1
     assert _os.path.exists(digest_entry)
     run(plugin.close())
+
+
+# ---------------------------------------------------------------------------
+# Sparse (chunk-granular) entries — the reshard sub-range tier
+# ---------------------------------------------------------------------------
+
+def _chunked_index(data, grain):
+    from torchsnapshot_tpu.hashing import digest_of_bytes, record_cache_key, record_chunk_info
+
+    rec = digest_of_bytes(data, grain, want_sha=True)
+    info = record_chunk_info(rec)
+    assert info is not None, "payload must span several chunks"
+    return (len(data), record_cache_key(rec), rec.get("crc"), info)
+
+
+def test_ranged_miss_populates_and_serves_sub_ranges(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(0).integers(0, 256, 20000, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    plugin.attach_digest_index({"obj": _chunked_index(data, grain)})
+    tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm)
+    try:
+        # Chunk-aligned miss: passes through AND populates chunks 0-1.
+        assert read(plugin, "obj", (0, 2 * grain)) == data[: 2 * grain]
+        assert inner.reads == 1
+        # Repeat: served from the sparse entry, zero origin reads.
+        assert read(plugin, "obj", (0, 2 * grain)) == data[: 2 * grain]
+        assert inner.reads == 1
+        # A sub-range inside the populated chunks also hits.
+        assert read(plugin, "obj", (100, grain + 50)) == data[100 : grain + 50]
+        assert inner.reads == 1
+        # A range touching an unpopulated chunk misses (and populates it).
+        assert (
+            read(plugin, "obj", (2 * grain, 4 * grain))
+            == data[2 * grain : 4 * grain]
+        )
+        assert inner.reads == 2
+        # Unaligned fetch: only fully contained chunks populate — chunk 4
+        # (partial in the fetched range) stays absent.
+        assert (
+            read(plugin, "obj", (4 * grain, 4 * grain + 100))
+            == data[4 * grain : 4 * grain + 100]
+        )
+        n3 = inner.reads
+        assert (
+            read(plugin, "obj", (4 * grain, len(data)))
+            == data[4 * grain :]
+        )
+        assert inner.reads == n3 + 1  # the partial chunk was NOT cached
+    finally:
+        telemetry.deactivate(tm, prev)
+    m = tm.metrics.as_dict()
+    assert m.get("cache.range_populates", 0) >= 2, m
+    assert m.get("cache.range_misses", 0) >= 2, m
+    assert m.get("cache.bypass_reads", 0) == 0, m
+    run(plugin.close())
+
+
+def test_sparse_entry_promotes_to_full_entry(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(1).integers(0, 256, 3 * grain, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    index = _chunked_index(data, grain)
+    plugin.attach_digest_index({"obj": index})
+    for k in range(3):
+        read(plugin, "obj", (k * grain, (k + 1) * grain))
+    # All chunks landed: the bitmap is gone and a FULL read hits locally.
+    entry = plugin._digest_entry_path(index[1])
+    import os as _os
+
+    assert _os.path.exists(entry)
+    assert not _os.path.exists(entry + ".chunks")
+    n = inner.reads
+    assert read(plugin, "obj") == data
+    assert inner.reads == n
+    run(plugin.close())
+
+
+def test_sparse_entry_never_serves_as_full_object(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(2).integers(0, 256, 3 * grain, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    plugin.attach_digest_index({"obj": _chunked_index(data, grain)})
+    read(plugin, "obj", (0, grain))  # one chunk resident
+    # Full-object read: the sparse entry must NOT satisfy it.
+    n = inner.reads
+    assert read(plugin, "obj") == data
+    assert inner.reads == n + 1
+    run(plugin.close())
+
+
+def test_corrupt_sparse_chunk_dropped_and_refetched(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(3).integers(0, 256, 3 * grain, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    index = _chunked_index(data, grain)
+    plugin.attach_digest_index({"obj": index})
+    read(plugin, "obj", (0, 2 * grain))
+    entry = plugin._digest_entry_path(index[1])
+    with open(entry, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm)
+    try:
+        assert read(plugin, "obj", (0, 2 * grain)) == data[: 2 * grain]
+    finally:
+        telemetry.deactivate(tm, prev)
+    assert tm.metrics.as_dict().get("cache.corrupt_entries", 0) == 1
+    import os as _os
+
+    # The corrupt sparse entry was dropped whole (data + bitmap) and the
+    # re-fetch re-populated it.
+    assert read(plugin, "obj", (0, 2 * grain)) == data[: 2 * grain]
+    run(plugin.close())
+
+
+def test_try_read_range_and_populate_range_publics(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(4).integers(0, 256, 4 * grain, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    plugin.attach_digest_index({"obj": _chunked_index(data, grain)})
+    # Nothing resident yet.
+    assert run(plugin.try_read_range("obj", 0, grain)) is None
+    # populate_range lands the two middle chunks (caller-verified bytes).
+    run(plugin.populate_range("obj", grain, 3 * grain, data[grain : 3 * grain]))
+    assert (
+        run(plugin.try_read_range("obj", grain, 3 * grain))
+        == data[grain : 3 * grain]
+    )
+    assert run(plugin.try_read_range("obj", 0, grain)) is None
+    # Digest-unknown paths are refused outright.
+    assert run(plugin.try_read_range("other", 0, 10)) is None
+    run(plugin.populate_range("other", 0, grain, data[:grain]))
+    assert run(plugin.try_read_range("other", 0, grain)) is None
+    run(plugin.close())
+
+
+def test_quarantine_and_eviction_remove_sparse_state(tmp_path):
+    grain = 4096
+    data = bytes(np.random.default_rng(5).integers(0, 256, 3 * grain, np.uint8))
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "obj", data)
+    index = _chunked_index(data, grain)
+    plugin.attach_digest_index({"obj": index})
+    read(plugin, "obj", (0, grain))
+    entry = plugin._digest_entry_path(index[1])
+    import os as _os
+
+    assert _os.path.exists(entry + ".chunks")
+    assert plugin.quarantine_path("obj") >= 1
+    assert not _os.path.exists(entry)
+    assert not _os.path.exists(entry + ".chunks")
+    run(plugin.close())
+
+
+def test_bypass_vs_range_miss_metric_split(tmp_path):
+    plugin, inner = make_cache(tmp_path)
+    seed(inner, "known", b"a" * 10000)
+    seed(inner, "unknown", b"b" * 10000)
+    plugin.attach_digest_index({"known": _chunked_index(b"a" * 10000, 4096)})
+    tm = telemetry.Telemetry()
+    prev = telemetry.activate(tm)
+    try:
+        read(plugin, "unknown", (5, 55))  # digest-unknown -> bypass
+        read(plugin, "known", (5, 55))  # digest-known -> range miss
+    finally:
+        telemetry.deactivate(tm, prev)
+    m = tm.metrics.as_dict()
+    assert m.get("cache.bypass_reads", 0) == 1, m
+    assert m.get("cache.range_misses", 0) == 1, m
+    run(plugin.close())

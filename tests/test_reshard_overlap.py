@@ -1,0 +1,309 @@
+"""Minimal-byte elastic reshard: exact-overlap fetch, chunk-granular
+verification of the ranged reads, the read cache's sub-range tier, and the
+need-aware swarm exchange across a REAL 2-process jax fleet.
+
+The tentpole claims under test:
+
+- a reshard fetches only the byte ranges each target shard overlaps
+  (origin bytes ≈ theoretical overlap bytes, not whole saved shards);
+- those ranged reads verify at chunk granularity against the v2
+  tree-digest sidecars instead of bypassing verification;
+- chunk-aligned sub-ranges populate (and later serve from) the read
+  cache — a repeat reshard on a warm host reads zero origin bytes;
+- an overlap range needed by several ranks (the replicated-axis case) is
+  origin-fetched exactly once fleet-wide and swapped peer-to-peer.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu import snapshot as snapshot_mod
+from torchsnapshot_tpu.io_preparers.sharded_array import ShardedArrayIOPreparer
+from torchsnapshot_tpu.scheduler import ReadVerificationError
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+from torchsnapshot_tpu.test_utils import run_with_processes
+from torchsnapshot_tpu.utils import knobs
+
+GRAIN = 4096
+
+
+def _col_sharded_take(tmp_path, shape=(16, 512), n_shards=4):
+    """Column-sharded save: every saved shard spans ALL rows, so a
+    row-subset target overlaps every shard PARTIALLY — the geometry where
+    whole-shard reads over-fetch and exact-overlap reads don't."""
+    rng = np.random.default_rng(7)
+    host = rng.standard_normal(shape).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:n_shards]), ("x",))
+    src = jax.device_put(jnp.asarray(host), NamedSharding(mesh, P(None, "x")))
+    path = str(tmp_path / "ckpt")
+    with knobs.override_hash_chunk_bytes(GRAIN):
+        Snapshot.take(path, {"s": StateDict(w=src)})
+    return path, host
+
+
+def _spy_reads(monkeypatch):
+    reads = []
+    orig_read = FSStoragePlugin.read
+
+    async def spying_read(self, read_io):
+        await orig_read(self, read_io)
+        if "sharded/" in read_io.path:
+            reads.append((read_io.path, len(read_io.buf.getbuffer())))
+
+    monkeypatch.setattr(FSStoragePlugin, "read", spying_read)
+    return reads
+
+
+def test_partial_overlap_reads_only_overlap_rows(tmp_path) -> None:
+    """prepare_read on a half-row target emits ranged reads covering ~half
+    of each column shard — not whole shards."""
+    path, host = _col_sharded_take(tmp_path)
+    entry = Snapshot(path).get_manifest()["0/s/w"]
+    assert entry.type == "sharded_array" and len(entry.shards) == 4
+    # Target: rows [0, 8) of all columns — half of every saved shard.
+    target = np.zeros((8, 512), dtype=np.float32)
+    reqs = ShardedArrayIOPreparer.prepare_read(
+        entry, [(target, [0, 0], [8, 512])]
+    )
+    assert len(reqs) == 4
+    shard_bytes = 16 * 128 * 4  # 8192 per column shard
+    for req in reqs:
+        assert req.byte_range is not None
+        begin, end = req.byte_range
+        assert (begin, end) == (0, shard_bytes // 2)
+    # The scatter is bit-exact.
+    # (Dense check via read_object below covers the full pipeline.)
+
+
+def test_reshard_restore_bit_exact_and_minimal_bytes(tmp_path, monkeypatch) -> None:
+    """Restoring a row-subset-shaped layout reads ≈ the overlap bytes:
+    the 8-dev row-sharded target restores bit-exact while per-process
+    origin bytes stay ≤ 1.1× the theoretical overlap (= full payload here,
+    split across ranged reads — no whole-shard over-fetch, no re-reads)."""
+    path, host = _col_sharded_take(tmp_path)
+    reads = _spy_reads(monkeypatch)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    live = jax.device_put(
+        jnp.zeros((16, 512), jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    tgt = StateDict(w=live)
+    Snapshot(path).restore({"s": tgt})
+    assert np.array_equal(np.asarray(tgt["w"]), host)
+    total = sum(n for _p, n in reads)
+    payload = host.nbytes  # every byte is someone's overlap at world 1
+    assert total <= 1.1 * payload, (total, payload)
+    stats = snapshot_mod.LAST_RESTORE_STATS
+    assert stats["attribution"]["origin_bytes"] == total
+
+
+def test_ranged_reshard_reads_verify_at_chunk_granularity(tmp_path) -> None:
+    """A corrupt hash chunk inside one saved shard is CAUGHT by the ranged
+    exact-overlap read (VERIFY_READS=all) — the read that previously
+    bypassed verification because its range wasn't chunk-aligned."""
+    path, host = _col_sharded_take(tmp_path)
+    entry = Snapshot(path).get_manifest()["0/s/w"]
+    loc = entry.shards[0].tensor.location
+    fpath = os.path.join(path, loc)
+    with open(fpath, "r+b") as f:
+        f.seek(GRAIN + 17)  # inside chunk 1 of the first shard
+        b = f.read(1)
+        f.seek(GRAIN + 17)
+        f.write(bytes([b[0] ^ 0xFF]))
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+    live = jax.device_put(
+        jnp.zeros((16, 512), jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    # A 4096-byte read budget forces chunk-aligned RANGED sub-reads of the
+    # 8192-byte shards — the reads that used to bypass verification.
+    with knobs.override_verify_reads("all"), (
+        knobs.override_memory_budget_bytes(4096)
+    ):
+        with pytest.raises(Exception) as exc_info:
+            Snapshot(path).restore({"s": StateDict(w=live)})
+    # Structured abort wrapping the double verification failure.
+    chain = []
+    e = exc_info.value
+    while e is not None:
+        chain.append(type(e))
+        e = e.__cause__
+    assert ReadVerificationError in chain, chain
+
+
+def test_reshard_ranged_reads_populate_and_hit_cache(tmp_path, monkeypatch) -> None:
+    """Chunk-aligned sub-range fetches populate the cache's sparse tier;
+    the repeat reshard reads ZERO origin bytes, and the bypass metric
+    split distinguishes servable misses from unaddressable ones."""
+    path, host = _col_sharded_take(tmp_path)
+    cache_dir = str(tmp_path / "cache")
+    reads = _spy_reads(monkeypatch)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+
+    from torchsnapshot_tpu import telemetry
+
+    def restore_once():
+        tm = telemetry.Telemetry()
+        live = jax.device_put(
+            jnp.zeros((16, 512), jnp.float32), NamedSharding(mesh, P("x"))
+        )
+        tgt = StateDict(w=live)
+        Snapshot(path).restore({"s": tgt}, _telemetry=tm)
+        assert np.array_equal(np.asarray(tgt["w"]), host)
+        return tm.metrics.as_dict()
+
+    # A 4096-byte budget splits every 8192-byte shard into two
+    # chunk-aligned RANGED reads — the sub-range tier's bread and butter.
+    with knobs.override_read_cache_dir(cache_dir), (
+        knobs.override_memory_budget_bytes(4096)
+    ):
+        cold = restore_once()
+        assert reads  # cold pass hit origin
+        reads.clear()
+        warm = restore_once()
+    assert reads == [], reads  # warm pass: zero origin bytes
+    assert cold.get("cache.range_populates", 0) > 0, cold
+    # The cold pass's ranged misses were counted as SERVABLE range misses
+    # (digest-known), not as unaddressable bypasses.
+    assert cold.get("cache.range_misses", 0) > 0, cold
+    assert cold.get("cache.bypass_reads", 0) == 0, cold
+    assert warm.get("cache.range_misses", 0) in (0, None) or warm.get(
+        "cache.hits", 0
+    ) > 0, warm
+
+
+# ---------------------------------------------------------------------------
+# 2-process fleet: the need-aware swarm exchange over a REAL global mesh
+# (jax.distributed on CPU: 2 procs x 2 devices).
+# ---------------------------------------------------------------------------
+
+def _fleet_take(shared: str):
+    """Column-sharded save on the 4-device global mesh (each proc saves its
+    2 addressable column shards)."""
+    import jax as _jax
+
+    path = os.path.join(shared, "ckpt")
+    shape = (16, 512)
+    host = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+    devices = np.array(_jax.devices())
+    mesh = Mesh(devices, ("x",))
+    src = _jax.make_array_from_callback(
+        shape, NamedSharding(mesh, P(None, "x")), lambda idx: host[idx]
+    )
+    with knobs.override_hash_chunk_bytes(GRAIN):
+        Snapshot.take(path, {"s": StateDict(w=src)})
+    return path, shape, host
+
+
+def _worker_reshard_replicated_axis(rank: int, world_size: int, shared: str) -> None:
+    """Target P(None, "b") on a (2, 2) mesh: BOTH processes need every
+    byte (axis "a" replicates across processes) — every chunk's need set
+    is {0, 1}, so each chunk must be origin-fetched exactly once
+    fleet-wide and swapped peer-to-peer."""
+    import jax as _jax
+
+    from torchsnapshot_tpu import swarm as swarm_mod
+
+    path, shape, host = _fleet_take(shared)
+    devices = np.array(_jax.devices()).reshape(2, 2)
+    mesh = Mesh(devices, ("a", "b"))
+    tgt_sharding = NamedSharding(mesh, P(None, "b"))
+    live = _jax.make_array_from_callback(
+        shape, tgt_sharding, lambda idx: np.zeros(shape, np.float32)[idx]
+    )
+    assert not live.sharding.is_fully_addressable
+    tgt = StateDict(w=live)
+    with knobs.override_swarm_restore(True):
+        Snapshot(path).restore({"s": tgt})
+    for shard in tgt["w"].addressable_shards:
+        assert np.array_equal(np.asarray(shard.data), host[shard.index])
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    assert d["objects"] == 4, d  # four column-shard objects swarmed
+    assert d["chunks"] == d["chunks_origin"] + d["chunks_peer"] + d["chunks_cache"], d
+    assert d["chunks_peer"] > 0, d  # the shared ranges actually swapped
+    assert d["peer_chunks_verified"] == d["chunks_peer"], d
+    with open(os.path.join(shared, f"diag_repl_{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "origin_reads": d["origin_reads"],
+                "origin_bytes": d["origin_bytes"],
+                "chunks": d["chunks"],
+            },
+            f,
+        )
+
+
+def _worker_reshard_disjoint(rank: int, world_size: int, shared: str) -> None:
+    """Target P("a") on a (2, 2) mesh: each process needs a disjoint half
+    of every column shard — all need sets are singletons, so the exchange
+    degrades to plain direct reads (zero store traffic) and each rank's
+    origin bytes ≈ half the payload, not the whole of every overlapping
+    shard."""
+    import jax as _jax
+
+    from torchsnapshot_tpu import swarm as swarm_mod
+
+    path, shape, host = _fleet_take(shared)
+    devices = np.array(_jax.devices()).reshape(2, 2)
+    mesh = Mesh(devices, ("a", "b"))
+    live = _jax.make_array_from_callback(
+        shape,
+        NamedSharding(mesh, P("a")),
+        lambda idx: np.zeros(shape, np.float32)[idx],
+    )
+    tgt = StateDict(w=live)
+    with knobs.override_swarm_restore(True):
+        Snapshot(path).restore({"s": tgt})
+    for shard in tgt["w"].addressable_shards:
+        assert np.array_equal(np.asarray(shard.data), host[shard.index])
+    d = dict(swarm_mod.LAST_RESTORE_SWARM)
+    payload = int(np.prod(shape)) * 4
+    assert d["chunks_peer"] == 0, d  # singleton need sets: no store traffic
+    assert d["origin_bytes"] <= 1.1 * payload / 2, (d, payload)
+    with open(os.path.join(shared, f"diag_disj_{rank}.json"), "w") as f:
+        json.dump({"origin_bytes": d["origin_bytes"]}, f)
+
+
+@pytest.mark.multiprocess
+def test_reshard_replicated_overlap_fetched_once_fleet_wide(tmp_path) -> None:
+    run_with_processes(
+        _worker_reshard_replicated_axis,
+        nproc=2,
+        init_jax_distributed=True,
+        args=(str(tmp_path),),
+    )
+    diags = [
+        json.load(open(str(tmp_path / f"diag_repl_{r}.json")))
+        for r in range(2)
+    ]
+    all_reads = [tuple(x) for d in diags for x in d["origin_reads"]]
+    # Every chunk origin-fetched exactly ONCE across the fleet.
+    assert len(all_reads) == len(set(all_reads)), all_reads
+    assert len(all_reads) == diags[0]["chunks"], (all_reads, diags)
+    # Total origin bytes == one copy of the payload, not K copies.
+    payload = 16 * 512 * 4
+    assert sum(d["origin_bytes"] for d in diags) == payload, diags
+    # Both ranks pulled some of the load (the sha1 spread).
+    assert all(d["origin_reads"] for d in diags), diags
+
+
+@pytest.mark.multiprocess
+def test_reshard_disjoint_overlaps_stay_direct(tmp_path) -> None:
+    run_with_processes(
+        _worker_reshard_disjoint,
+        nproc=2,
+        init_jax_distributed=True,
+        args=(str(tmp_path),),
+    )
+    diags = [
+        json.load(open(str(tmp_path / f"diag_disj_{r}.json")))
+        for r in range(2)
+    ]
+    payload = 16 * 512 * 4
+    # Fleet-wide: exactly one copy of the payload, split across the ranks.
+    assert sum(d["origin_bytes"] for d in diags) == payload, diags

@@ -1,0 +1,231 @@
+"""Property-style geometry tests for the reshard planning math:
+``subdivide`` / ``overlap`` / ``overlap_row_intervals`` /
+``shard_read_intervals`` edge cases — zero-size overlaps, single-row
+shards, non-divisible mesh transposes, and narrow-dtype (int4/bf16) row
+widths that stress the chunk-alignment math — each compared against a
+dense NumPy reference (scatter into a full array, compare element-wise).
+"""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_preparers.sharded_array import (
+    overlap,
+    overlap_row_intervals,
+    shard_read_intervals,
+    subdivide,
+)
+from torchsnapshot_tpu.manifest import ArrayEntry, Shard
+from torchsnapshot_tpu.serialization import Serializer
+from torchsnapshot_tpu.utils import knobs
+
+
+def _grid_rects(shape, splits):
+    """Tile ``shape`` into a grid of rectangles: ``splits`` pieces per dim
+    (uneven allowed — the non-divisible mesh-transpose shape)."""
+    def cuts(n, k):
+        base, extra = divmod(n, k)
+        out, pos = [0], 0
+        for i in range(k):
+            pos += base + (1 if i < extra else 0)
+            out.append(pos)
+        return out
+
+    axes = [cuts(n, k) for n, k in zip(shape, splits)]
+    rects = []
+
+    def rec(d, off, sz):
+        if d == len(shape):
+            rects.append((list(off), list(sz)))
+            return
+        for i in range(len(axes[d]) - 1):
+            rec(d + 1, off + [axes[d][i]], sz + [axes[d][i + 1] - axes[d][i]])
+
+    rec(0, [], [])
+    return rects
+
+
+def _raw_shard(offsets, sizes, dtype="float32", byte_range=None):
+    return Shard(
+        offsets=list(offsets),
+        sizes=list(sizes),
+        tensor=ArrayEntry(
+            location="sharded/t.x",
+            serializer=Serializer.RAW,
+            dtype=dtype,
+            shape=list(sizes),
+            replicated=False,
+            byte_range=byte_range,
+        ),
+    )
+
+
+def _dense_reference_rows(shard_off, shard_sz, rects):
+    """Rows of the shard some rect overlaps, per a dense boolean scatter."""
+    mask = np.zeros(tuple(shard_sz), dtype=bool)
+    full = np.zeros([o + s for o, s in zip(shard_off, shard_sz)], dtype=bool)
+    for off, sz in rects:
+        sl = tuple(slice(o, o + s) for o, s in zip(off, sz))
+        full[sl] = True
+    shard_sl = tuple(slice(o, o + s) for o, s in zip(shard_off, shard_sz))
+    mask = full[shard_sl]
+    flat = mask.reshape(shard_sz[0], -1).any(axis=1)
+    return {int(r) for r in np.nonzero(flat)[0]}
+
+
+@pytest.mark.parametrize(
+    "shape,src_splits,dst_splits",
+    [
+        ((16, 16), (8, 1), (4, 2)),
+        ((16, 16), (4, 2), (2, 4)),
+        ((16, 10), (8, 1), (2, 4)),  # non-divisible columns
+        ((17, 7), (4, 1), (3, 2)),  # nothing divides anything
+        ((5, 3, 4), (5, 1, 1), (1, 3, 2)),  # single-row shards, 3-D
+    ],
+)
+def test_overlap_matrix_vs_dense_reference(shape, src_splits, dst_splits):
+    """Every (saved shard, target rect) overlap agrees with a dense scatter:
+    the union of overlap row intervals covers exactly the rows the dense
+    reference marks, and the slice pairs copy the right elements."""
+    src_rects = _grid_rects(shape, src_splits)
+    dst_rects = _grid_rects(shape, dst_splits)
+    world = np.arange(int(np.prod(shape))).reshape(shape)
+    for s_off, s_sz in src_rects:
+        rows = overlap_row_intervals(s_off, s_sz, dst_rects)
+        covered = set()
+        for b, e in rows:
+            assert 0 <= b < e <= s_sz[0]
+            covered.update(range(b, e))
+        assert covered == _dense_reference_rows(s_off, s_sz, dst_rects)
+        # Intervals are sorted, non-overlapping, non-adjacent (maximal).
+        for (b1, e1), (b2, e2) in zip(rows, rows[1:]):
+            assert e1 < b2
+        # Slice pairs scatter the correct elements.
+        src_sl = tuple(slice(o, o + s) for o, s in zip(s_off, s_sz))
+        shard_data = world[src_sl]
+        for d_off, d_sz in dst_rects:
+            got = overlap(s_off, s_sz, d_off, d_sz)
+            dst_sl = tuple(slice(o, o + s) for o, s in zip(d_off, d_sz))
+            expect_any = bool(
+                _dense_reference_rows(
+                    s_off, s_sz, [(d_off, d_sz)]
+                )
+            )
+            assert (got is not None) == expect_any
+            if got is None:
+                continue
+            src_slices, dst_slices = got
+            dst_buf = np.full(tuple(d_sz), -1)
+            dst_buf[dst_slices] = shard_data[src_slices]
+            ref = np.full(tuple(d_sz), -1)
+            inter = world[dst_sl].copy()
+            mask = np.zeros(shape, dtype=bool)
+            mask[src_sl] = True
+            sel = mask[dst_sl]
+            ref[sel] = inter[sel]
+            assert np.array_equal(dst_buf, ref)
+
+
+def test_zero_size_overlap_is_none():
+    # Touching edges (hi == lo) must NOT produce an empty copy spec.
+    assert overlap([0, 0], [4, 4], [4, 0], [4, 4]) is None
+    assert overlap([0, 0], [4, 4], [0, 4], [4, 4]) is None
+    # Zero-size rects never overlap anything.
+    assert overlap([0, 0], [0, 4], [0, 0], [4, 4]) is None
+    assert overlap_row_intervals([0, 0], [4, 4], [([4, 0], [4, 4])]) == []
+
+
+def test_subdivide_single_row_and_tiny_budget():
+    # A single row wider than the budget is admitted whole (escape hatch).
+    pieces = subdivide([0, 0], [1, 100], 8, 16, dim=0)
+    assert pieces == [([0, 0], [1, 100])]
+    # Row-exact budget: one row per piece, tiling exactly.
+    pieces = subdivide([3, 0], [5, 4], 4, 16, dim=0)
+    assert [p[1][0] for p in pieces] == [1] * 5
+    assert [p[0][0] for p in pieces] == [3, 4, 5, 6, 7]
+    # Scalar shards pass through.
+    assert subdivide([], [], 4, 1) == [([], [])]
+
+
+@pytest.mark.parametrize("dtype,itemsize", [("bfloat16", 2), ("int4", 1)])
+def test_narrow_dtype_row_widths_stress_alignment(dtype, itemsize):
+    """bf16/int4 row byte-widths (odd multiples of small itemsizes) against
+    a grain that never divides them: intervals stay row-aligned, cover
+    every overlap row, and chunk-expand outward only."""
+    # int4 is stored packed by the RAW serializer family as one byte per
+    # element in this repo's manifest byte math (itemsize from
+    # string_to_dtype); what matters here is row_bytes = 7 * itemsize.
+    from torchsnapshot_tpu.serialization import string_to_dtype
+
+    real_itemsize = string_to_dtype(dtype).itemsize
+    rows, cols = 64, 7
+    row_bytes = cols * real_itemsize
+    shard = _raw_shard([0, 0], [rows, cols], dtype=dtype)
+    rects = [([10, 0], [9, cols]), ([40, 2], [3, 4])]
+    grain = 64  # never a multiple of row_bytes for these dtypes
+    with knobs.override_read_merge_gap_bytes(0):
+        ivals = shard_read_intervals(shard, rects, None, grain=grain)
+    assert ivals is not None and ivals
+    covered = set()
+    for b, e in ivals:
+        assert b % row_bytes == 0 and e % row_bytes == 0
+        covered.update(range(b // row_bytes, e // row_bytes))
+    assert covered.issuperset(set(range(10, 19)) | set(range(40, 43)))
+    # Outward chunk expansion stays within the payload.
+    assert all(0 <= b < e <= rows * row_bytes for b, e in ivals)
+    # Each interval's start is the row-floor of a grain boundary (or 0).
+    for b, _e in ivals:
+        if b:
+            assert (b // grain * grain) // row_bytes * row_bytes <= b
+
+
+def test_shard_read_intervals_full_coverage_and_budget():
+    shard = _raw_shard([0, 0], [64, 8])  # row_bytes 32, payload 2048
+    full = [([0, 0], [64, 8])]
+    # Full coverage, no budget: one whole-shard read (None sentinel).
+    assert shard_read_intervals(shard, full, None) is None
+    # Full coverage with a budget: exact tiling at row-aligned steps.
+    ivals = shard_read_intervals(shard, full, 512)
+    assert ivals == [(0, 512), (512, 1024), (1024, 1536), (1536, 2048)]
+    # Partial coverage fetches only the overlap rows.
+    ivals = shard_read_intervals(shard, [([8, 0], [4, 8])], None)
+    assert ivals == [(8 * 32, 12 * 32)]
+    # No overlap: empty plan.
+    assert shard_read_intervals(shard, [([64, 0], [1, 8])], None) == []
+    # A budget below one row degrades to one-row reads, never zero.
+    ivals = shard_read_intervals(shard, [([0, 0], [3, 8])], 1)
+    assert ivals == [(0, 32), (32, 64), (64, 96)]
+
+
+def test_shard_read_intervals_gap_merge_and_grain():
+    shard = _raw_shard([0, 0], [64, 8])  # row_bytes 32
+    rects = [([0, 0], [2, 8]), ([4, 0], [2, 8])]  # gap of 2 rows (64 B)
+    with knobs.override_read_merge_gap_bytes(0):
+        assert shard_read_intervals(shard, rects, None) == [
+            (0, 64),
+            (128, 192),
+        ]
+    with knobs.override_read_merge_gap_bytes(64):
+        assert shard_read_intervals(shard, rects, None) == [(0, 192)]
+    # Grain expansion: intervals snap outward to 128-byte chunks, then to
+    # rows — and the now-adjacent expansions coalesce into one interval.
+    with knobs.override_read_merge_gap_bytes(0):
+        ivals = shard_read_intervals(shard, rects, None, grain=128)
+    assert ivals == [(0, 256)]
+    # byte_range base offsets shift the grain lattice: payload byte 0 sits
+    # at object byte 96, so chunk boundaries land at payload 32, 160, ...
+    shard_off = _raw_shard([0, 0], [64, 8], byte_range=(96, 96 + 2048))
+    with knobs.override_read_merge_gap_bytes(0):
+        ivals = shard_read_intervals(
+            shard_off, [([4, 0], [2, 8])], None, grain=128
+        )
+    (b, e), = ivals
+    assert b % 32 == 0 and e % 32 == 0
+    assert b <= 4 * 32 and e >= 6 * 32
+
+
+def test_shard_read_intervals_rejects_non_raw():
+    shard = _raw_shard([0], [4], dtype="float32")
+    shard.tensor.serializer = Serializer.PICKLE
+    with pytest.raises(ValueError):
+        shard_read_intervals(shard, [([0], [4])], None)

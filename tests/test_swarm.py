@@ -341,3 +341,160 @@ def test_swarm_cache_warm_restore_reads_zero_origin_bytes(tmp_path):
     run_with_processes(
         _worker_swarm_cache_warm, nproc=2, args=(str(tmp_path),)
     )
+
+
+# ---------------------------------------------------------------------------
+# Need-aware plans (the reshard case)
+# ---------------------------------------------------------------------------
+
+def test_need_order_rotates_members_only():
+    members = frozenset({1, 3, 6})
+    order = swarm.need_order("obj", (0, 4096), members)
+    assert sorted(order) == [1, 3, 6]
+    # Deterministic and member-restricted for every chunk extent.
+    for ext in [(0, 4096), (4096, 8192), (8192, 12288)]:
+        a = swarm.need_order("obj", ext, members)
+        b = swarm.need_order("obj", ext, members)
+        assert a == b
+        assert set(a) == set(members)
+    assert swarm.need_order("obj", (0, 1), frozenset()) == []
+
+
+def test_plan_objects_with_need_maps():
+    payloads = {"o1": os.urandom(20000)}
+    digests = _v2_digests(payloads, grain=4096)
+    n_chunks = 5
+    need = {
+        "o1": [frozenset({0})] * 2
+        + [frozenset({0, 2})] * 2
+        + [frozenset({3})]
+    }
+    (plan,) = swarm.plan_objects(["o1"], digests, world=4, need_maps=need)
+    assert plan.need == need["o1"]
+    for k, order in enumerate(plan.orders):
+        assert set(order) == set(need["o1"][k])
+    # A need map whose chunk count drifts from the grid fails loudly.
+    with pytest.raises(ValueError):
+        swarm.plan_objects(
+            ["o1"], digests, world=4, need_maps={"o1": [frozenset({0})]}
+        )
+    # Without a need map the legacy all-rank orders are preserved.
+    (plain,) = swarm.plan_objects(["o1"], digests, world=4)
+    assert plain.need is None
+    assert all(sorted(o) == [0, 1, 2, 3] for o in plain.orders)
+    assert len(plain.orders) == n_chunks
+
+
+def _sharded_entry_with_digests(tmp_path, grain=4096):
+    """A real sharded save (8 devices, column-sharded) + its v2 digest
+    index, for the reshard plan-math tests."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu.hashing import digest_of_bytes
+
+    host = np.arange(16 * 512, dtype=np.float32).reshape(16, 512)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    src = jax.device_put(
+        jnp.asarray(host), NamedSharding(mesh, P(None, "x"))
+    )
+    path = os.path.join(str(tmp_path), "ckpt")
+    with knobs.override_hash_chunk_bytes(grain):
+        Snapshot.take(path, {"s": StateDict(w=src)})
+    entry = Snapshot(path).get_manifest()["0/s/w"]
+    digests = {}
+    for s in entry.shards:
+        with open(os.path.join(path, s.tensor.location), "rb") as f:
+            digests[s.tensor.location] = digest_of_bytes(
+                f.read(), grain, want_sha=True
+            )
+    return entry, digests, host
+
+
+def test_plan_reshard_need_from_global_sharding(tmp_path):
+    """Need sets derive from the GLOBAL device→index map: a synthetic
+    2-process split of the 8 local devices yields, for a row-sharded
+    target over column-sharded saves, disjoint per-process chunk halves —
+    and a replicated-axis target yields {0, 1} everywhere."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    entry, digests, _host = _sharded_entry_with_digests(tmp_path)
+    devices = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devices, ("a", "b"))
+    # Synthetic fleet: mesh row 0 -> process 0, row 1 -> process 1.
+    row_of = {d.id: i for i, row in enumerate(devices) for d in row}
+    proc_of = lambda d: row_of[d.id]
+
+    # P("a"): dim0 halves per process -> every chunk needed by exactly one.
+    need = swarm.plan_reshard_need(
+        entry,
+        NamedSharding(mesh, P("a")),
+        [16, 512],
+        digests,
+        world=2,
+        process_of_device=proc_of,
+    )
+    assert need is not None and len(need) == 4
+    for loc, sets in need.items():
+        assert len(sets) == 2  # 8192-byte shards, 4096 grain
+        assert sets[0] == frozenset({0})  # rows [0, 8) -> chunk 0
+        assert sets[1] == frozenset({1})  # rows [8, 16) -> chunk 1
+    # P(None, "b"): dim1 sharded, dim0 axis replicated across processes ->
+    # every chunk needed by both.
+    need = swarm.plan_reshard_need(
+        entry,
+        NamedSharding(mesh, P(None, "b")),
+        [16, 512],
+        digests,
+        world=2,
+        process_of_device=proc_of,
+    )
+    assert need is not None
+    for sets in need.values():
+        assert all(s == frozenset({0, 1}) for s in sets)
+    # A process outside the coordinator world poisons the plan -> None
+    # (every rank falls back to direct identically).
+    assert (
+        swarm.plan_reshard_need(
+            entry,
+            NamedSharding(mesh, P("a")),
+            [16, 512],
+            digests,
+            world=1,
+            process_of_device=proc_of,
+        )
+        is None
+    )
+    # v1 digests (no chunk grid) -> None.
+    assert (
+        swarm.plan_reshard_need(
+            entry,
+            NamedSharding(mesh, P("a")),
+            [16, 512],
+            {},
+            world=2,
+            process_of_device=proc_of,
+        )
+        is None
+    )
+
+
+def test_entry_reshardable_gates(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    entry, digests, _host = _sharded_entry_with_digests(tmp_path)
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("a", "b"))
+    live = jax.device_put(
+        jnp.zeros((16, 512), jnp.float32), NamedSharding(mesh, P("a"))
+    )
+    # Fully addressable target (single process): need sets would all be
+    # local — plain exact-overlap direct reads are already minimal.
+    assert not swarm.entry_reshardable(entry, live, digests)
+    # Not a jax array / shape drift / non-sharded entries never qualify.
+    assert not swarm.entry_reshardable(entry, np.zeros((16, 512)), digests)
+    arr_entry = entry.shards[0].tensor
+    assert not swarm.entry_reshardable(arr_entry, live, digests)

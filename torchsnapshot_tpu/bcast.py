@@ -186,22 +186,31 @@ def select_restore_mode(  # spmd-pure
     digests: Optional[Dict[str, object]],
 ) -> str:
     """The restore transport for one entry — ``"direct"`` | ``"bcast"`` |
-    ``"swarm"`` — as a pure function of the manifest entry, knobs, the
-    (globally consistent) target kind, and the snapshot's merged digest
-    sidecars, so every rank selects the identical mode:
+    ``"swarm"`` | ``"reshard"`` — as a pure function of the manifest entry,
+    knobs, the (globally consistent) target kind, and the snapshot's merged
+    digest sidecars, so every rank selects the identical mode:
 
-    - not replicated (or raw-range/sharded-onto-sharded) → **direct**;
     - replicated, ≤ ``BCAST_MAX_BYTES`` → **bcast** (single elected reader
       + store fan-out: one payload key, minimal coordination);
     - replicated, above the cap, with v2 chunk-grid sidecar records →
       **swarm** (chunk-granular: every rank fetches a distinct chunk
       subset from origin and trades the rest peer-to-peer — origin bytes
       stay ~1× the object at any world size);
-    - anything else → **direct** (the pre-swarm K× cliff, now only for
-      objects the sidecars can't chunk-verify).
+    - a sharded save onto a SHARDED multi-process target whose shards are
+      byte-addressable and chunk-gridded → **reshard** (the need-aware
+      swarm: overlap ranges needed by several ranks — the replicated-axis
+      case — are origin-fetched once fleet-wide and swapped peer-to-peer;
+      ranges needed by one rank stay plain direct reads);
+    - anything else → **direct** (including raw-range views and objects
+      the sidecars can't chunk-verify).
     """
     cost = replicated_read_cost(entry, live)
     if cost is None:
+        if swarm_enabled:
+            from . import swarm as swarm_mod
+
+            if swarm_mod.entry_reshardable(entry, live, digests):
+                return "reshard"
         return "direct"
     if cost <= knobs.get_broadcast_max_bytes():
         return "bcast" if bcast_enabled else "direct"

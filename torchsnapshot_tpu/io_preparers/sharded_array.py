@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import hashing
 from ..io_types import BufferConsumer, BufferType, ReadReq, WriteReq
 from ..manifest import ArrayEntry, Shard, ShardedArrayEntry
 from ..serialization import (
@@ -127,44 +128,139 @@ def overlap(  # spmd-pure
     return tuple(src_slices), tuple(dst_slices)
 
 
-def _budgeted_pieces(
-    shard: Shard, buffer_size_limit_bytes: Optional[int]
-) -> List[Tuple[List[int], List[int], Optional[Tuple[int, int]]]]:
-    """Split one saved shard into budget-sized row groups along dim 0.
+def overlap_row_intervals(  # spmd-pure
+    shard_off: Sequence[int],
+    shard_sz: Sequence[int],
+    target_rects: Sequence[Tuple[Sequence[int], Sequence[int]]],
+) -> List[Tuple[int, int]]:
+    """Union of the shard-relative dim-0 row intervals at least one target
+    rectangle overlaps — merged and sorted. The row is the contiguity unit
+    of a C-contiguous saved shard: a run of whole rows is exactly one byte
+    range, so these intervals are what a minimal-byte reshard fetches
+    (column-partial overlaps still cover their whole rows)."""
+    ivals: List[Tuple[int, int]] = []
+    for dst_off, dst_sz in target_rects:
+        ov = overlap(shard_off, shard_sz, dst_off, dst_sz)
+        if ov is None:
+            continue
+        sl = ov[0][0]
+        ivals.append((sl.start, sl.stop))
+    ivals.sort()
+    merged: List[Tuple[int, int]] = []
+    for b, e in ivals:
+        if merged and b <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((b, e))
+    return merged
 
-    Returns ``(offsets, sizes, byte_range)`` triples in *global* coordinates;
-    ``byte_range`` is relative to the start of the shard's serialized bytes
-    (``None`` means read the whole shard — no split needed or possible).
-    Shards are saved C-contiguous, so a run of whole dim-0 rows is exactly one
-    contiguous byte range. A single row wider than the budget is admitted
-    whole — the same one-over-budget escape hatch the scheduler uses.
+
+def record_grain_for(  # spmd-pure
+    digests: Optional[Dict[str, object]], location: str
+) -> Optional[int]:
+    """The hash-chunk grain of the storage object at ``location`` when its
+    sidecar record carries a v2 chunk grid (multi-chunk objects only —
+    single-chunk objects keep exact v1 records), else None. Aligning shard
+    sub-reads to this grain is what lets ranged reshard reads verify at
+    chunk granularity (``VERIFY_READS``) and lets the read cache serve and
+    populate chunk-aligned sub-ranges instead of bypassing."""
+    if not digests:
+        return None
+    info = hashing.record_chunk_info(digests.get(location))
+    return info[0] if info is not None else None
+
+
+def shard_read_intervals(  # spmd-pure
+    shard: Shard,
+    target_rects: Sequence[Tuple[Sequence[int], Sequence[int]]],
+    buffer_size_limit_bytes: Optional[int],
+    grain: Optional[int] = None,
+    merge_gap_bytes: Optional[int] = None,
+) -> Optional[List[Tuple[int, int]]]:
+    """The byte intervals (relative to the shard's serialized payload) a
+    reader must fetch to cover every target overlap — the exact-overlap
+    plan for one RAW saved shard:
+
+    1. the overlap row intervals (``overlap_row_intervals``) become byte
+       intervals via the shard's row stride;
+    2. each interval expands *outward* to hash-chunk boundaries (``grain``,
+       in object coordinates — the shard payload may sit at a byte offset
+       inside its object) and then to row boundaries, so every fully
+       contained chunk is digest-verifiable and cache-addressable;
+    3. near-adjacent intervals whose gap is at most ``merge_gap_bytes``
+       (default: the ``READ_MERGE_GAP_BYTES`` knob) coalesce — on
+       high-latency backends a small discarded gap beats a round trip;
+    4. intervals above ``buffer_size_limit_bytes`` split at row boundaries
+       (grain-floored when a grain is known), the same one-over-budget
+       escape hatch as everywhere: a single row wider than the budget is
+       admitted whole.
+
+    Returns ``None`` when the plan is ONE read of the whole payload (full
+    coverage, no split required — callers emit the legacy whole-shard
+    request so (path, byte_range) shapes stay stable for the collective
+    paths), ``[]`` when no target overlaps the shard, else the intervals.
+    SPMD-pure: derived from the entry, the target rectangles, knobs, and
+    the (globally consistent) digest grain only.
     """
-
     entry = shard.tensor
-    if (
-        entry.serializer != Serializer.RAW
-        or not shard.sizes
-        or buffer_size_limit_bytes is None
-    ):
-        return [(shard.offsets, shard.sizes, None)]
+    if entry.serializer != Serializer.RAW or not shard.sizes:
+        raise ValueError("shard_read_intervals needs a RAW non-scalar shard")
+    rows = overlap_row_intervals(shard.offsets, shard.sizes, target_rects)
+    if not rows:
+        return []
     itemsize = string_to_dtype(entry.dtype).itemsize
-    pieces = subdivide(
-        shard.offsets, shard.sizes, itemsize, buffer_size_limit_bytes, dim=0
-    )
-    if len(pieces) == 1:
-        return [(shard.offsets, shard.sizes, None)]
     row_bytes = int(np.prod(shard.sizes[1:])) * itemsize
-    return [
-        (
-            off,
-            sz,
-            (
-                (off[0] - shard.offsets[0]) * row_bytes,
-                (off[0] - shard.offsets[0] + sz[0]) * row_bytes,
-            ),
-        )
-        for off, sz in pieces
+    nbytes = shard.sizes[0] * row_bytes
+    base0 = entry.byte_range[0] if entry.byte_range else 0
+    if merge_gap_bytes is None:
+        merge_gap_bytes = knobs.get_read_merge_gap_bytes()
+
+    def floor_align(pos: int) -> int:
+        if grain:
+            pos = (base0 + pos) // grain * grain - base0
+        return max(0, pos // row_bytes * row_bytes)
+
+    def ceil_align(pos: int) -> int:
+        if grain:
+            pos = -((base0 + pos) // -grain) * grain - base0
+        pos = min(pos, nbytes)
+        return min(-(pos // -row_bytes) * row_bytes, nbytes)
+
+    expanded = [
+        (floor_align(b * row_bytes), ceil_align(e * row_bytes))
+        for b, e in rows
     ]
+    merged: List[Tuple[int, int]] = []
+    for b, e in expanded:
+        if merged and b - merged[-1][1] <= merge_gap_bytes:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((b, e))
+    step = None
+    if buffer_size_limit_bytes is not None:
+        step = max(row_bytes, buffer_size_limit_bytes // row_bytes * row_bytes)
+    if (
+        len(merged) == 1
+        and merged[0] == (0, nbytes)
+        and (step is None or nbytes <= step)
+    ):
+        return None
+    if step is None:
+        return merged
+    split: List[Tuple[int, int]] = []
+    for b, e in merged:
+        cur = b
+        while e - cur > step:
+            cut = cur + step
+            if grain:
+                g = max(0, (base0 + cut) // grain * grain - base0)
+                g = g // row_bytes * row_bytes
+                if g > cur:
+                    cut = g
+            split.append((cur, cut))
+            cur = cut
+        split.append((cur, e))
+    return split
 
 
 class ShardedArrayBufferConsumer(BufferConsumer):
@@ -258,7 +354,18 @@ def _framed_shard_reads(
     if not shard.sizes:
         pieces = [(shard.offsets, shard.sizes)]
     else:
-        pieces = subdivide(shard.offsets, shard.sizes, itemsize, effective, dim=0)
+        # Exact-overlap: only the row intervals some target actually needs
+        # are sliced into frame-covering pieces — a reshard of a framed
+        # shard fetches the covering frames of its overlaps, not of the
+        # whole shard.
+        rects = [(d_off, d_sz) for _dst, d_off, d_sz in targets]
+        pieces = []
+        for r0, r1 in overlap_row_intervals(shard.offsets, shard.sizes, rects):
+            off = list(shard.offsets)
+            sz = list(shard.sizes)
+            off[0] = shard.offsets[0] + r0
+            sz[0] = r1 - r0
+            pieces.extend(subdivide(off, sz, itemsize, effective, dim=0))
     prefix = [0]
     for s in frame_table:
         prefix.append(prefix[-1] + int(s))
@@ -356,20 +463,27 @@ class ShardedArrayIOPreparer:
         targets: List[TargetShard],
         buffer_size_limit_bytes: Optional[int] = None,
         frame_tables: Optional[Dict[str, List[int]]] = None,
+        digests: Optional[Dict[str, object]] = None,
     ) -> List[ReadReq]:
         """Plan reads scattering saved shards into ``targets``.
 
-        Each saved shard overlapping at least one target is read exactly once
-        per process; non-overlapping saved shards are never fetched. With
-        ``buffer_size_limit_bytes``, raw-serialized shards larger than the
-        budget are fetched as row-aligned byte-range sub-reads (the sharded
-        analogue of ``ArrayIOPreparer.prepare_read``'s budget chunking,
-        reference ``io_preparers/tensor.py:120-166``) so ``read_object`` on an
-        operator VM never holds more than ~budget bytes of any one shard.
-        FRAMED compressed shards (``frame_bytes`` set) get the same treatment
-        when their ``.ftab`` frame table is supplied: each row group maps to
-        the covering compression frames and only those bytes are fetched and
-        decompressed.
+        **Exact-overlap fetch**: for RAW shards, only the byte ranges the
+        targets actually overlap are emitted — the row intervals of the
+        overlap union, expanded outward to the sidecar hash-chunk grain
+        (``digests`` — so ranged reads verify at chunk granularity under
+        ``VERIFY_READS`` and the read cache can serve/populate the
+        sub-ranges), coalesced across gaps up to ``READ_MERGE_GAP_BYTES``,
+        and split at ``buffer_size_limit_bytes`` so ``read_object`` on an
+        operator VM never holds more than ~budget bytes of any one shard
+        (``shard_read_intervals``). An N→M reshard therefore fetches ≈ the
+        theoretical overlap bytes instead of every overlapping shard whole.
+        Non-overlapping saved shards are never fetched; a full-coverage
+        unsplit plan stays the legacy single whole-shard request, so the
+        collective (bcast/swarm) paths keep their stable (path, byte_range)
+        shapes. FRAMED compressed shards (``frame_bytes`` set) fetch the
+        compression frames covering their overlap row intervals when their
+        ``.ftab`` frame table is supplied. SPMD-pure: a pure function of
+        the entry, targets, knobs, and the merged digest sidecars.
         """
         read_reqs: List[ReadReq] = []
         for shard in entry.shards:
@@ -387,40 +501,70 @@ class ShardedArrayIOPreparer:
                 )
                 continue
             base = tuple(shard.tensor.byte_range) if shard.tensor.byte_range else None
-            for sub_off, sub_sz, byte_range in _budgeted_pieces(
-                shard, buffer_size_limit_bytes
-            ):
+            base0 = base[0] if base else 0
+
+            def whole_shard_req(shard=shard, base=base):
+                copy_specs = []
+                for dst, dst_off, dst_sz in targets:
+                    ov = overlap(shard.offsets, shard.sizes, dst_off, dst_sz)
+                    if ov is not None:
+                        copy_specs.append((dst, ov[0], ov[1]))
+                if not copy_specs:
+                    return None
+                return ReadReq(
+                    path=shard.tensor.location,
+                    buffer_consumer=ShardedArrayBufferConsumer(
+                        shard.tensor, copy_specs
+                    ),
+                    byte_range=base,
+                )
+
+            if shard.tensor.serializer != Serializer.RAW or not shard.sizes:
+                req = whole_shard_req()
+                if req is not None:
+                    read_reqs.append(req)
+                continue
+            rects = [(d_off, d_sz) for _dst, d_off, d_sz in targets]
+            intervals = shard_read_intervals(
+                shard,
+                rects,
+                buffer_size_limit_bytes,
+                grain=record_grain_for(digests, shard.tensor.location),
+            )
+            if intervals is None:
+                req = whole_shard_req()
+                if req is not None:
+                    read_reqs.append(req)
+                continue
+            itemsize = string_to_dtype(shard.tensor.dtype).itemsize
+            row_bytes = int(np.prod(shard.sizes[1:])) * itemsize
+            for b, e in intervals:
+                r0, r1 = b // row_bytes, e // row_bytes
+                sub_off = list(shard.offsets)
+                sub_sz = list(shard.sizes)
+                sub_off[0] = shard.offsets[0] + r0
+                sub_sz[0] = r1 - r0
                 copy_specs = []
                 for dst, dst_off, dst_sz in targets:
                     ov = overlap(sub_off, sub_sz, dst_off, dst_sz)
                     if ov is not None:
-                        src_slices, dst_slices = ov
-                        copy_specs.append((dst, src_slices, dst_slices))
+                        copy_specs.append((dst, ov[0], ov[1]))
                 if not copy_specs:
-                    continue
-                sub_entry = (
-                    shard.tensor
-                    if byte_range is None
-                    else ArrayEntry(
-                        location=shard.tensor.location,
-                        serializer=shard.tensor.serializer,
-                        dtype=shard.tensor.dtype,
-                        shape=list(sub_sz),
-                        replicated=shard.tensor.replicated,
-                    )
+                    continue  # gap-merged rows with no overlap of their own
+                sub_entry = ArrayEntry(
+                    location=shard.tensor.location,
+                    serializer=shard.tensor.serializer,
+                    dtype=shard.tensor.dtype,
+                    shape=list(sub_sz),
+                    replicated=shard.tensor.replicated,
                 )
-                if byte_range is None:
-                    final_range = base
-                else:
-                    offset = base[0] if base else 0
-                    final_range = (offset + byte_range[0], offset + byte_range[1])
                 read_reqs.append(
                     ReadReq(
                         path=shard.tensor.location,
                         buffer_consumer=ShardedArrayBufferConsumer(
                             sub_entry, copy_specs
                         ),
-                        byte_range=final_range,
+                        byte_range=(base0 + b, base0 + e),
                     )
                 )
         return read_reqs
@@ -441,6 +585,37 @@ def alloc_target_shards(sharding, global_shape, np_dtype) -> Dict[Tuple[int, ...
         if key not in out:
             out[key] = (np.empty(tuple(sizes), dtype=np_dtype), offsets, sizes)
     return out
+
+
+def process_shard_map(  # spmd-pure
+    sharding, global_shape, process_of_device=None
+) -> Optional[Dict[int, List[Tuple[List[int], List[int]]]]]:
+    """Unique target-shard rectangles per PROCESS of ``sharding``, from the
+    GLOBAL device→index map — identical on every rank, which is what lets a
+    reshard plan reason about every peer's read set with zero collectives
+    (the need-set math of the reshard swarm). ``process_of_device`` is
+    injectable for tests that simulate a fleet on one host (defaults to the
+    device's ``process_index``). Rectangles are sorted by offsets; returns
+    None when the sharding can't produce a global map (exotic sharding
+    types — callers fall back to direct reads)."""
+    if process_of_device is None:
+        def process_of_device(d):
+            return getattr(d, "process_index", 0)
+    try:
+        index_map = sharding.devices_indices_map(
+            tuple(int(s) for s in global_shape)
+        )
+    except Exception:  # pragma: no cover - exotic sharding types
+        return None
+    out: Dict[int, Dict[Tuple[int, ...], Tuple[List[int], List[int]]]] = {}
+    for device, index in index_map.items():
+        p = int(process_of_device(device))
+        offsets, sizes = index_to_offsets_sizes(index, global_shape)
+        out.setdefault(p, {}).setdefault(tuple(offsets), (offsets, sizes))
+    return {
+        p: [rect for _k, rect in sorted(rects.items())]
+        for p, rects in sorted(out.items())
+    }
 
 
 def is_fully_replicated_sharding(sharding, global_shape) -> bool:

@@ -1229,13 +1229,16 @@ class Snapshot:
 
         bcast_items: List["bcast_mod.BroadcastItem"] = []
         swarm_items: List["swarm_mod.SwarmItem"] = []
+        swarm_need: Dict[str, List[frozenset]] = {}
         for idx, (logical_path, entry) in enumerate(entries.items()):
             live = live_flattened.get(logical_path)
-            # direct / bcast / swarm, selected SPMD-pure per entry (size,
-            # world gate, knobs, and sidecar chunk grids — identical on
-            # every rank): replicated entries under BCAST_MAX_BYTES ride
-            # the single-reader broadcast, larger chunk-addressable ones
-            # the peer-to-peer swarm, everything else the direct pipeline.
+            # direct / bcast / swarm / reshard, selected SPMD-pure per
+            # entry (size, world gate, knobs, sidecar chunk grids, and the
+            # GLOBAL target sharding — identical on every rank):
+            # replicated entries under BCAST_MAX_BYTES ride the
+            # single-reader broadcast, larger chunk-addressable ones the
+            # peer-to-peer swarm, sharded-onto-sharded reshards the
+            # need-aware swarm, everything else the direct pipeline.
             mode = bcast_mod.select_restore_mode(
                 entry,
                 live,
@@ -1243,6 +1246,41 @@ class Snapshot:
                 swarm_enabled and coord is not None,
                 digests,
             )
+            if mode == "reshard":
+                # Need sets from the global device→index map: which ranks'
+                # exact-overlap plans touch each hash chunk of each shard
+                # object. Pure, so every rank computes the identical map —
+                # including the identical None on failure (all fall back
+                # to direct together).
+                need = swarm_mod.plan_reshard_need(
+                    entry,
+                    live.sharding,
+                    entry.shape,
+                    digests,
+                    coord.get_world_size(),
+                )
+                if need is None:
+                    mode = "direct"
+                else:
+                    reqs, finalize = _prepare_restore_one(
+                        logical_path,
+                        entry,
+                        live,
+                        loaded,
+                        buffer_size_limit_bytes=None,
+                        frame_tables=frame_tables,
+                        digests=digests,
+                    )
+                    swarm_need.update(need)
+                    swarm_items.append(
+                        swarm_mod.SwarmItem(
+                            logical_path,
+                            reqs,
+                            finalize,
+                            paths=[s.tensor.location for s in entry.shards],
+                        )
+                    )
+                    continue
             if mode in ("bcast", "swarm"):
                 # Collective path. Planned with NO budget sub-read limit so
                 # the (path, byte_range) sequence is a pure function of the
@@ -1256,6 +1294,7 @@ class Snapshot:
                     loaded,
                     buffer_size_limit_bytes=None,
                     frame_tables=frame_tables,
+                    digests=digests,
                 )
                 if mode == "bcast":
                     bcast_items.append(
@@ -1273,6 +1312,7 @@ class Snapshot:
                 loaded,
                 buffer_size_limit_bytes=_memory_budget_bytes_per_read,
                 frame_tables=frame_tables,
+                digests=digests,
             )
             if finalize is not None:
                 if not reqs:
@@ -1314,7 +1354,10 @@ class Snapshot:
             # Swarm phase: chunk-granular fan-out for replicated objects
             # above the broadcast cap — every rank origin-reads a distinct
             # chunk subset and trades the rest peer-to-peer, each chunk
-            # verified against the sidecar grid on receipt.
+            # verified against the sidecar grid on receipt. Reshard items
+            # ride the same exchange with per-chunk need sets: shared
+            # overlap ranges are fetched once fleet-wide, disjoint ones
+            # stay plain direct reads.
             swarm_mod.run_swarm(
                 swarm_items,
                 storage,
@@ -1322,6 +1365,7 @@ class Snapshot:
                 event_loop,
                 executor=pools.consuming_executor() if pools else None,
                 digests=digests,
+                need_maps=swarm_need or None,
             )
 
         if knobs.is_batching_enabled():
@@ -1426,6 +1470,7 @@ class Snapshot:
                 loaded,
                 buffer_size_limit_bytes=memory_budget_bytes,
                 frame_tables=frame_tables,
+                digests=digest_index,
             )
             from .batcher import batch_read_requests
 
@@ -1494,6 +1539,7 @@ class Snapshot:
                 loaded,
                 buffer_size_limit_bytes=memory_budget_bytes,
                 frame_tables=frame_tables,
+                digests=digests,
             )
             read_reqs.extend(reqs)
             if finalize is not None:
@@ -2692,12 +2738,18 @@ def _prepare_restore_one(  # spmd-pure
     loaded: Dict[str, Any],
     buffer_size_limit_bytes: Optional[int] = None,
     frame_tables: Optional[Dict[str, List[int]]] = None,
+    digests: Optional[Dict[str, Any]] = None,
 ) -> Tuple[List[ReadReq], Optional[Callable[[], None]]]:
     """Plan the reads for one entry; returns (read_reqs, finalizer).
 
     The finalizer (run after all reads complete) converts filled host buffers
     into the final leaf value (e.g. ``jax.device_put`` with the live
     sharding) and records it in ``loaded[logical_path]``.
+
+    ``digests`` (the snapshot's merged checksum sidecars — identical on
+    every rank) lets the sharded exact-overlap planner align its byte
+    ranges to the v2 hash-chunk grain, so ranged reshard reads verify at
+    chunk granularity and compose with the read cache's sub-range tier.
     """
     from .serialization import string_to_dtype
 
@@ -2772,7 +2824,11 @@ def _prepare_restore_one(  # spmd-pure
             buffers = alloc_target_shards(sharding, entry.shape, np_dtype)
             targets = [(buf, off, sz) for buf, off, sz in buffers.values()]
             reqs = ShardedArrayIOPreparer.prepare_read(
-                entry, targets, buffer_size_limit_bytes, frame_tables=frame_tables
+                entry,
+                targets,
+                buffer_size_limit_bytes,
+                frame_tables=frame_tables,
+                digests=digests,
             )
 
             def finalize_sharded() -> None:
@@ -2795,6 +2851,7 @@ def _prepare_restore_one(  # spmd-pure
             [(target, [0] * len(entry.shape), list(entry.shape))],
             buffer_size_limit_bytes,
             frame_tables=frame_tables,
+            digests=digests,
         )
         loaded[logical_path] = target
         return reqs, None

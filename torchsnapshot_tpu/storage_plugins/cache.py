@@ -41,14 +41,34 @@ Guarantees:
   ``TORCHSNAPSHOT_TPU_READ_CACHE_BYTES``.
 - **Ranged reads never over-fetch**: a byte-range miss passes through to
   the origin untouched (lazy partial restores must read only the ranges
-  they need); ranges are served locally only when the full object is
-  already cached.
+  they need); ranges are served locally from an already-cached full object
+  — or, for digest-known objects with a v2 chunk grid, from a **sparse
+  entry** holding only some hash chunks (below).
+
+**Sparse (chunk-granular) entries**: objects whose sidecar record carries a
+v2 chunk grid cache *sub-ranges* too — the reshard read path fetches only
+the byte ranges each target shard overlaps, and without this tier every
+ranged read re-fetched from origin forever. A sparse entry is the data file
+(pre-sized to the full object, written at chunk offsets) plus a
+``<entry>.chunks`` presence bitmap; the bitmap rename is the commit point,
+so a concurrent reader sees a chunk as present only after its bytes landed.
+A ranged read is served when every hash chunk it touches is present (the
+covering chunks are digest-verified, then sliced); a ranged origin fetch
+populates exactly the chunks it fully contains. When the last chunk lands
+the bitmap is removed and the entry IS a full entry — the two tiers
+converge. Ranged misses on digest-known paths count as
+``cache.range_misses`` (servable, not yet resident); ranged reads of paths
+the digest index doesn't know remain ``cache.bypass_reads`` (the cache
+cannot address them at all).
 - **Fail-open**: any cache-store failure (disk full, permissions) degrades
   to a plain origin read — the cache can slow a restore down, never fail it.
 
 Telemetry: ``cache.hits``/``cache.misses`` (+ ``_bytes``),
-``cache.bypass_reads`` (ranged pass-throughs), ``cache.evictions``/
-``cache.evicted_bytes``, ``cache.corrupt_entries``; populates are traced as
+``cache.bypass_reads`` (ranged pass-throughs on digest-unknown paths),
+``cache.range_misses`` (ranged pass-throughs on digest-known paths — the
+sub-range tier COULD have served them), ``cache.range_populates`` (chunk
+sub-range populates), ``cache.evictions``/``cache.evicted_bytes``,
+``cache.corrupt_entries``; populates are traced as
 ``storage.cache_populate`` spans.
 """
 
@@ -221,6 +241,10 @@ class CachedStoragePlugin(StoragePlugin):
         finally:
             self._unpin(entry)
 
+    @staticmethod
+    def _bitmap_path(entry: str) -> str:
+        return entry + ".chunks"
+
     def _read_entry_pinned(
         self,
         entry: str,
@@ -228,6 +252,12 @@ class CachedStoragePlugin(StoragePlugin):
         verify: bool,
         byte_range: Optional[Tuple[int, int]] = None,
     ) -> Optional[bytes]:
+        if os.path.exists(self._bitmap_path(entry)):
+            # A presence bitmap marks a SPARSE entry: the data file is
+            # pre-sized to the full object but only some chunks hold real
+            # bytes — never servable as a complete entry (the sub-range
+            # tier serves what it can through _read_sparse_range).
+            return None
         try:
             with open(entry, "rb") as f:
                 data = f.read()
@@ -290,12 +320,190 @@ class CachedStoragePlugin(StoragePlugin):
                 with contextlib.suppress(OSError):
                     os.remove(tmp)
                 raise
+            # A full populate supersedes any sparse state: the data file now
+            # holds every byte, so the presence bitmap (which would demote
+            # the entry back to partial) must go.
+            with contextlib.suppress(OSError):
+                os.remove(self._bitmap_path(entry))
             with self._lock:
                 if self._total_bytes is not None:
                     self._total_bytes += len(data)
             self._maybe_evict()
         finally:
             self._unpin(entry)
+
+    # -- sparse (chunk-granular) entries -------------------------------------
+    def _chunk_span(
+        self, expect: Tuple, begin: int, end: int, contained: bool
+    ) -> Optional[Tuple[int, int, int]]:
+        """``(first_chunk, last_chunk_exclusive, grain)`` of the hash
+        chunks *touching* [begin, end) (``contained=False``, the serve-side
+        coverage check) or *fully contained* in it (``contained=True``, the
+        populate side — a partially fetched chunk must never be cached).
+        None when the record has no usable chunk grid."""
+        chunks = expect[3] if len(expect) > 3 else None
+        if chunks is None:
+            return None
+        grain = chunks[0]
+        size = expect[0]
+        if not isinstance(grain, int) or grain <= 0 or not size:
+            return None
+        n = -(size // -grain)
+        if contained:
+            c0 = -(begin // -grain)
+            c1 = c0
+            for k in range(c0, n):
+                if min((k + 1) * grain, size) <= end:
+                    c1 = k + 1
+                else:
+                    break
+        else:
+            c0 = min(n, max(0, begin) // grain)
+            c1 = min(n, -(end // -grain))
+        if c1 <= c0:
+            return None
+        return c0, c1, grain
+
+    def _verify_span(
+        self, span: bytes, expect: Tuple, c0: int, c1: int
+    ) -> Optional[str]:
+        """Digest-verify chunks ``c0..c1`` of a sparse entry's span bytes
+        (``span`` starts exactly at chunk ``c0``'s extent)."""
+        _grain_, key_shas, crcs = expect[3][0], expect[3][1], expect[3][2]
+        bad = hashing._chunk_mismatches(
+            memoryview(span),
+            _grain_,
+            key_shas[:c1] if key_shas is not None else None,
+            crcs[:c1] if crcs is not None else None,
+            c0,
+            0,
+        )
+        return f"chunk mismatch at {bad}" if bad else None
+
+    def _read_sparse_range(
+        self, entry: str, expect: Tuple, begin: int, end: int, verify: bool
+    ) -> Optional[bytes]:
+        """Serve [begin, end) from a sparse entry: every touching chunk
+        must be present per the bitmap; the covering chunk span is read,
+        verified (all covering chunks are fully resident by construction),
+        and sliced. Returns None on miss; a corrupt span drops the whole
+        sparse entry (data + bitmap)."""
+        span_info = self._chunk_span(expect, begin, end, contained=False)
+        if span_info is None:
+            return None
+        c0, c1, grain = span_info
+        self._pin(entry)
+        try:
+            try:
+                with open(self._bitmap_path(entry), "rb") as f:
+                    bitmap = f.read()
+            except OSError:
+                return None
+            if len(bitmap) < c1 or not all(bitmap[c0:c1]):
+                return None
+            size = expect[0]
+            span_b, span_e = c0 * grain, min(c1 * grain, size)
+            try:
+                with open(entry, "rb") as f:
+                    f.seek(span_b)
+                    span = f.read(span_e - span_b)
+            except OSError:
+                return None
+            if len(span) != span_e - span_b:
+                return None
+            if verify and self._verify_span(span, expect, c0, c1) is not None:
+                telemetry.counter_add("cache.corrupt_entries")
+                logger.warning(
+                    "corrupt sparse cache entry %s (chunks %d..%d); "
+                    "dropping and falling back to origin",
+                    entry,
+                    c0,
+                    c1,
+                )
+                self._drop_entry(entry)
+                return None
+            with contextlib.suppress(OSError):
+                os.utime(entry)
+                os.utime(self._bitmap_path(entry))
+            return span[begin - span_b : end - span_b]
+        finally:
+            self._unpin(entry)
+
+    def _write_entry_range(
+        self, entry: str, expect: Tuple, begin: int, end: int, data: bytes
+    ) -> None:
+        """Populate the hash chunks fully contained in [begin, end) into a
+        sparse entry. The bitmap rename is the commit point: chunk bytes
+        land in the (pre-sized) data file first, presence flips after — a
+        concurrent reader never sees a chunk it can't read. When the last
+        chunk lands the bitmap is removed and the entry IS a full entry."""
+        span_info = self._chunk_span(expect, begin, end, contained=True)
+        if span_info is None:
+            return
+        c0, c1, grain = span_info
+        size = expect[0]
+        n = -(size // -grain)
+        bitmap_path = self._bitmap_path(entry)
+        self._pin(entry)
+        try:
+            created = False
+            with self._lock:
+                # One writer mutates a given sparse entry's files at a time
+                # in this process; cross-process writers land identical
+                # content (same digests), so a lost bitmap bit just costs a
+                # future re-fetch (fail-open).
+                if os.path.exists(entry) and not os.path.exists(bitmap_path):
+                    return  # already a complete entry
+                if not os.path.exists(bitmap_path):
+                    self._replace_bitmap(bitmap_path, bytes(n))
+                if not os.path.exists(entry):
+                    os.makedirs(os.path.dirname(entry), exist_ok=True)
+                    with open(entry, "wb") as f:
+                        f.truncate(size)
+                    created = True
+                span_b, span_e = c0 * grain, min(c1 * grain, size)
+                with open(entry, "r+b") as f:
+                    f.seek(span_b)
+                    f.write(data[span_b - begin : span_e - begin])
+                with open(bitmap_path, "rb") as f:
+                    bitmap = bytearray(f.read())
+                if len(bitmap) != n:
+                    bitmap = bytearray(n)
+                for k in range(c0, c1):
+                    bitmap[k] = 1
+                if all(bitmap):
+                    # Complete: the data file now holds every chunk —
+                    # removing the bitmap promotes it to a full entry.
+                    with contextlib.suppress(OSError):
+                        os.remove(bitmap_path)
+                else:
+                    self._replace_bitmap(bitmap_path, bytes(bitmap))
+                if created and self._total_bytes is not None:
+                    self._total_bytes += size
+            telemetry.counter_add("cache.range_populates")
+            self._maybe_evict()
+        finally:
+            self._unpin(entry)
+
+    def _replace_bitmap(self, bitmap_path: str, content: bytes) -> None:
+        tmp_dir = os.path.join(self.cache_dir, _TMP_DIR)
+        os.makedirs(tmp_dir, exist_ok=True)
+        os.makedirs(os.path.dirname(bitmap_path), exist_ok=True)
+        tmp = os.path.join(tmp_dir, f"{uuid.uuid4().hex}.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(content)
+            os.replace(tmp, bitmap_path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+
+    def _drop_entry(self, entry: str) -> None:
+        """Remove an entry's data file AND its sparse bitmap (if any)."""
+        for p in (entry, self._bitmap_path(entry)):
+            with contextlib.suppress(OSError):
+                os.remove(p)
 
     def _scan(self) -> List[Tuple[str, int, float]]:
         """All cache entries as (abs path, size, mtime) — the local-store
@@ -305,6 +513,11 @@ class CachedStoragePlugin(StoragePlugin):
             base = os.path.join(self.cache_dir, sub)
             for dirpath, _, filenames in os.walk(base):
                 for name in filenames:
+                    if name.endswith(".chunks"):
+                        # Sparse-presence bitmaps ride their data file: never
+                        # evicted alone (a partial data file with no bitmap
+                        # would masquerade as complete), removed with it.
+                        continue
                     p = os.path.join(dirpath, name)
                     try:
                         st = os.stat(p)
@@ -342,6 +555,8 @@ class CachedStoragePlugin(StoragePlugin):
                         total -= sz
                         evicted += 1
                         evicted_bytes += sz
+                    with contextlib.suppress(OSError):
+                        os.remove(self._bitmap_path(p))
             if evicted:
                 telemetry.counter_add("cache.evictions", evicted)
                 telemetry.counter_add("cache.evicted_bytes", evicted_bytes)
@@ -349,8 +564,7 @@ class CachedStoragePlugin(StoragePlugin):
                 self._total_bytes = total
 
     def _invalidate_path(self, path: str) -> None:
-        with contextlib.suppress(OSError):
-            os.remove(self._path_entry_path(path))
+        self._drop_entry(self._path_entry_path(path))
 
     def quarantine_path(self, path: str) -> int:
         """Remove every local entry that could serve ``path`` — the
@@ -367,6 +581,8 @@ class CachedStoragePlugin(StoragePlugin):
             targets.add(self._digest_entry_path(digest[1]))
         removed = 0
         for entry in targets:
+            with contextlib.suppress(OSError):
+                os.remove(self._bitmap_path(entry))
             try:
                 size = os.path.getsize(entry)
                 os.remove(entry)
@@ -413,6 +629,83 @@ class CachedStoragePlugin(StoragePlugin):
             self.stats["hit_bytes"] += len(data)
         return data
 
+    async def try_read_range(
+        self, path: str, begin: int, end: int
+    ) -> Optional[bytes]:
+        """Bytes [begin, end) of ``path`` from the LOCAL store only
+        (verified like any hit: a full entry's covering chunks, or a sparse
+        entry whose bitmap covers the range), or None — never touches the
+        origin. The reshard swarm probes this per needed chunk so a warm
+        host serves its assigned chunks from local bytes. Digest-known
+        paths only — an unvalidated path-keyed entry is not strong enough
+        to seed a fan-out."""
+        entry, expect = self._entry_for(path)
+        if expect is None:
+            return None
+        loop = asyncio.get_running_loop()
+        verify = knobs.is_read_cache_verify_enabled()
+        data = await loop.run_in_executor(
+            self._get_executor(),
+            self._read_entry,
+            entry,
+            expect,
+            verify,
+            (begin, end),
+        )
+        if data is not None:
+            data = data[begin:end]
+        else:
+            data = await loop.run_in_executor(
+                self._get_executor(),
+                self._read_sparse_range,
+                entry,
+                expect,
+                begin,
+                end,
+                verify,
+            )
+        if data is not None:
+            telemetry.counter_add("cache.hits")
+            telemetry.counter_add("cache.hit_bytes", len(data))
+            self.stats["hit_bytes"] += len(data)
+        return data
+
+    async def populate_range(
+        self, path: str, begin: int, end: int, data: bytes
+    ) -> None:
+        """Populate the hash chunks of ``path`` fully contained in
+        [begin, end) from bytes the caller already holds and has verified —
+        the reshard swarm lands each rank's assembled chunk runs here, so
+        the NEXT reshard on this host serves them locally. No-op for paths
+        without a v2 chunk grid in the digest index. Fail-open like every
+        populate."""
+        entry, expect = self._entry_for(path)
+        if expect is None:
+            return
+        try:
+            with telemetry.span(
+                "storage.cache_populate",
+                cat="storage",
+                path=path,
+                nbytes=len(data),
+            ):
+                await asyncio.get_running_loop().run_in_executor(
+                    self._get_executor(),
+                    self._write_entry_range,
+                    entry,
+                    expect,
+                    begin,
+                    end,
+                    bytes(data),
+                )
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "failed to range-populate read cache for %s (restore "
+                "proceeds; caching disabled for this range)",
+                path,
+                exc_info=True,
+            )
+
     async def populate_object(self, path: str, data: bytes) -> None:
         """Populate ``path``'s cache entry from bytes the caller already
         holds and has verified — the swarm restore lands each assembled,
@@ -458,10 +751,15 @@ class CachedStoragePlugin(StoragePlugin):
             and read_io.byte_range[1] == expect[0]
         )
         if read_io.byte_range is not None and not full_range:
-            # Serve a range only from an already-cached full object; a miss
-            # passes through untouched so lazy partial restores never fetch
-            # more than the ranges they asked for. With a v2 chunk grid the
-            # hit verifies only the chunks the range touches.
+            # Serve a range from an already-cached full object, or — for
+            # digest-known objects with a v2 chunk grid — from a sparse
+            # entry whose bitmap covers every chunk the range touches. A
+            # miss passes through untouched so lazy partial restores never
+            # fetch more than the ranges they asked for, then populates the
+            # chunks the fetched range fully contains (the reshard read
+            # path's repeat-restore hits ride this tier). Hit verification
+            # covers only the chunks the range touches.
+            begin, end = read_io.byte_range
             data = await loop.run_in_executor(
                 executor,
                 self._read_entry,
@@ -470,16 +768,55 @@ class CachedStoragePlugin(StoragePlugin):
                 verify,
                 read_io.byte_range,
             )
-            if data is None:
+            if data is not None:
+                data = data[begin:end]
+            elif expect is not None:
+                data = await loop.run_in_executor(
+                    executor,
+                    self._read_sparse_range,
+                    entry,
+                    expect,
+                    begin,
+                    end,
+                    verify,
+                )
+            if data is not None:
+                telemetry.counter_add("cache.hits")
+                telemetry.counter_add("cache.hit_bytes", len(data))
+                self.stats["hit_bytes"] += len(data)
+                read_io.buf.write(data)
+                return
+            if expect is None:
+                # The digest index doesn't know this path: the cache can't
+                # address (or ever serve) the range — a true bypass.
                 telemetry.counter_add("cache.bypass_reads")
                 await self.inner.read(read_io)
                 return
-            begin, end = read_io.byte_range
-            sliced = data[begin:end]
-            telemetry.counter_add("cache.hits")
-            telemetry.counter_add("cache.hit_bytes", len(sliced))
-            self.stats["hit_bytes"] += len(sliced)
-            read_io.buf.write(sliced)
+            # Digest-known range the cache COULD have served but doesn't
+            # hold yet: its own counter, so the reshard bench can prove the
+            # sub-range tier's hits against a denominator of real misses.
+            telemetry.counter_add("cache.range_misses")
+            await self.inner.read(read_io)
+            fetched = read_io.buf.getvalue()
+            self.stats["miss_bytes"] += len(fetched)
+            telemetry.counter_add("cache.miss_bytes", len(fetched))
+            try:
+                await loop.run_in_executor(
+                    executor,
+                    self._write_entry_range,
+                    entry,
+                    expect,
+                    begin,
+                    begin + len(fetched),
+                    fetched,
+                )
+            except Exception:  # noqa: BLE001 - fail-open by contract
+                logger.warning(
+                    "failed to range-populate read cache for %s (read "
+                    "served from origin)",
+                    path,
+                    exc_info=True,
+                )
             return
 
         data = await loop.run_in_executor(

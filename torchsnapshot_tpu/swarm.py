@@ -91,6 +91,7 @@ from .scheduler import (
     ReadVerificationError,
     fetch_read_io,
 )
+from .serialization import Serializer
 from .storage_plugins.cloud_retry import CollectiveProgress
 from .utils import knobs
 
@@ -198,9 +199,15 @@ def entry_swarmable(  # spmd-pure
 class ObjectPlan:
     """One swarmed storage object's deterministic chunk plan: extents from
     the sidecar grid, and per-chunk server orders from the sha1 election
-    order (identical on every rank)."""
+    order (identical on every rank). ``need`` — when set — is the per-chunk
+    frozenset of ranks whose exact-overlap plan touches the chunk (the
+    reshard case); None means every rank needs every chunk (the replicated
+    case). Orders are restricted to the need members: a rank that doesn't
+    need a chunk is never elected to serve it."""
 
-    __slots__ = ("path", "size", "grain", "shas", "crcs", "extents", "orders")
+    __slots__ = (
+        "path", "size", "grain", "shas", "crcs", "extents", "orders", "need"
+    )
 
     def __init__(
         self,
@@ -211,6 +218,7 @@ class ObjectPlan:
         crcs: Optional[List[int]],
         extents: List[Tuple[int, int]],
         orders: List[List[int]],
+        need: Optional[List[frozenset]] = None,
     ) -> None:
         self.path = path
         self.size = size
@@ -219,15 +227,37 @@ class ObjectPlan:
         self.crcs = crcs
         self.extents = extents
         self.orders = orders
+        self.need = need
+
+
+def need_order(  # spmd-pure
+    path: str, byte_range: Tuple[int, int], members: frozenset
+) -> List[int]:
+    """The re-election order for one chunk restricted to the ranks that
+    need it: the sha1 election rotates the SORTED member list, so load
+    spreads across exactly the need set and every rank derives the
+    identical order — a replicated-overlap range needed by K ranks is
+    fetched from origin by one of those K and swapped peer-to-peer."""
+    ranks = sorted(members)
+    if not ranks:
+        return []
+    from .bcast import elect_reader
+
+    start = elect_reader(path, byte_range, len(ranks))
+    return [ranks[(start + i) % len(ranks)] for i in range(len(ranks))]
 
 
 def plan_objects(  # spmd-pure
-    paths: List[str], digests: Optional[Dict[str, object]], world: int
+    paths: List[str],
+    digests: Optional[Dict[str, object]],
+    world: int,
+    need_maps: Optional[Dict[str, List[frozenset]]] = None,
 ) -> List[ObjectPlan]:
     """The full swarm plan for a deterministic path sequence. Pure: every
-    rank passes the identical ``paths`` (manifest order) and ``digests``
-    (merged sidecars), so all ranks hold byte-identical plans — the
-    invariant the fenced store keys below rest on."""
+    rank passes the identical ``paths`` (manifest order), ``digests``
+    (merged sidecars), and ``need_maps`` (derived from the GLOBAL target
+    sharding — ``plan_reshard_need``), so all ranks hold byte-identical
+    plans — the invariant the fenced store keys below rest on."""
     from .bcast import reader_order
 
     plans: List[ObjectPlan] = []
@@ -239,9 +269,119 @@ def plan_objects(  # spmd-pure
             raise ValueError(f"swarm-planned path has no chunk grid: {path}")
         size, grain, shas, crcs = grid
         extents = hashing.chunk_extents(size, grain)
-        orders = [reader_order(path, ext, world) for ext in extents]
-        plans.append(ObjectPlan(path, size, grain, shas, crcs, extents, orders))
+        need = (need_maps or {}).get(path)
+        if need is not None:
+            if len(need) != len(extents):
+                raise ValueError(
+                    f"need map for {path} has {len(need)} chunks, "
+                    f"grid has {len(extents)}"
+                )
+            orders = [
+                need_order(path, ext, need[k])
+                for k, ext in enumerate(extents)
+            ]
+        else:
+            orders = [reader_order(path, ext, world) for ext in extents]
+        plans.append(
+            ObjectPlan(path, size, grain, shas, crcs, extents, orders, need)
+        )
     return plans
+
+
+def entry_reshardable(  # spmd-pure
+    entry: Entry, live: Any, digests: Optional[Dict[str, object]]
+) -> bool:
+    """Whether a sharded entry restored onto a SHARDED (not fully
+    replicated) jax target is shaped for the need-aware swarm: every saved
+    shard RAW, non-scalar, un-ranged (byte-addressable rows), every shard
+    object carrying a v2 chunk grid, and the target sharding global enough
+    to reason about every peer's read set (multi-process — on a fully
+    addressable sharding every need set would be local and direct reads
+    are already minimal). SPMD-pure."""
+    if not isinstance(entry, ShardedArrayEntry) or not entry.shards:
+        return False
+    try:
+        import jax
+
+        if not isinstance(live, jax.Array):
+            return False
+    except ImportError:  # pragma: no cover - jax always present here
+        return False
+    if list(live.shape) != list(entry.shape):
+        return False
+    if getattr(live.sharding, "is_fully_addressable", True):
+        # Single-process target: every need set would be this process
+        # alone — direct exact-overlap reads are already minimal-byte.
+        return False
+    for s in entry.shards:
+        t = s.tensor
+        if t.serializer != Serializer.RAW or not s.sizes:
+            return False
+        if t.byte_range is not None or getattr(t, "raw_range", None) is not None:
+            return False
+    return entry_swarmable(entry, digests)
+
+
+def plan_reshard_need(  # spmd-pure
+    entry: ShardedArrayEntry,
+    sharding,
+    global_shape,
+    digests: Optional[Dict[str, object]],
+    world: int,
+    process_of_device=None,
+) -> Optional[Dict[str, List[frozenset]]]:
+    """Per-chunk need sets for restoring ``entry`` onto ``sharding``: for
+    every saved-shard object, chunk ``k`` → the frozenset of processes
+    whose exact-overlap read plan (``shard_read_intervals`` with no budget
+    — the SAME function that plans each rank's local reads, so needs and
+    reads can never disagree) touches chunk ``k``. Derived from the GLOBAL
+    device→index map, so every rank computes the identical map with zero
+    planning collectives. Returns None when the plan isn't derivable (no
+    global map, a process outside the coordinator world, a chunk nobody
+    reads) — callers fall back to direct reads, identically everywhere."""
+    from math import prod as _prod
+
+    from .io_preparers.sharded_array import (
+        process_shard_map,
+        shard_read_intervals,
+    )
+    from .serialization import string_to_dtype
+
+    def _np_itemsize(dtype_str: str) -> int:
+        return string_to_dtype(dtype_str).itemsize
+
+    pmap = process_shard_map(sharding, global_shape, process_of_device)
+    if pmap is None or len(pmap) < 2:
+        return None
+    if any(p < 0 or p >= world for p in pmap):
+        return None
+    need: Dict[str, List[frozenset]] = {}
+    for shard in entry.shards:
+        loc = shard.tensor.location
+        grid = chunk_grid(digests, loc)
+        if grid is None:
+            return None
+        size, grain, _shas, _crcs = grid
+        itemsize = _np_itemsize(shard.tensor.dtype)
+        payload = int(_prod(shard.sizes)) * itemsize
+        if payload != size:
+            return None  # object holds more than the raw rows: not row-addressable
+        extents = hashing.chunk_extents(size, grain)
+        sets: List[set] = [set() for _ in extents]
+        for p, rects in pmap.items():
+            try:
+                intervals = shard_read_intervals(shard, rects, None, grain=grain)
+            except ValueError:
+                return None
+            if intervals is None:
+                intervals = [(0, size)]
+            for b, e in intervals:
+                for k in range(b // grain, min(len(sets), -(e // -grain))):
+                    sets[k].add(p)
+        if any(not s for s in sets):
+            return None  # a chunk nobody reads: geometry drifted, bail out
+        need[loc] = [frozenset(s) for s in sets]
+    return need
 
 
 def chunk_check(
@@ -270,19 +410,25 @@ def chunk_check(
 class SwarmItem:
     """One swarm-eligible entry's planned reads + finalizer (the swarm
     analogue of :class:`~.bcast.BroadcastItem`). ``reqs`` may carry byte
-    ranges — they are served as slices of the assembled object."""
+    ranges — they are served as slices of the assembled object. ``paths``
+    (when set) is the entry's FULL ordered storage-object list: reshard
+    items register every shard object even when this rank's reqs touch
+    only some of them, because the store-key object indices must be
+    identical on every rank while the local reqs are not."""
 
-    __slots__ = ("logical_path", "reqs", "finalize")
+    __slots__ = ("logical_path", "reqs", "finalize", "paths")
 
     def __init__(
         self,
         logical_path: str,
         reqs: List[ReadReq],
         finalize: Optional[Callable[[], None]],
+        paths: Optional[List[str]] = None,
     ) -> None:
         self.logical_path = logical_path
         self.reqs = reqs
         self.finalize = finalize
+        self.paths = paths
 
 
 class _SwarmSession:
@@ -345,13 +491,16 @@ class _SwarmSession:
     ) -> List[Optional[bytes]]:
         return await self._store_call(self.ns.try_get_many, keys)
 
-    async def ack(self, obj: int, k: int, max_attempts: int) -> None:
+    async def ack(
+        self, obj: int, k: int, max_attempts: int, quorum: Optional[int] = None
+    ) -> None:
         """Acknowledge that this rank holds chunk ``(obj, k)`` and will
         never read its payload keys again. The LAST acker (counter ==
-        world) eagerly deletes the chunk's payload keys and the counter —
-        the swarm's store-side GC."""
+        quorum — the chunk's need-set size, default the whole world)
+        eagerly deletes the chunk's payload keys and the counter — the
+        swarm's store-side GC."""
         n = await self._store_call(self.ns.add, f"ack/{obj}/{k}", 1)
-        if n >= self.world:
+        if n >= (quorum if quorum is not None else self.world):
             keys = [self._key(obj, k, a) for a in range(max_attempts)]
             keys.append(f"ack/{obj}/{k}")
             await self._store_call(self.ns.delete_many, keys)
@@ -417,9 +566,51 @@ class _SwarmSession:
             return data
         return None
 
+    async def cache_probe_range(
+        self, plan: ObjectPlan, k: int
+    ) -> Optional[bytes]:
+        """Chunk ``k``'s bytes from the local read cache (full or sparse
+        entry, verified), or None — the reshard warm-host probe."""
+        if self._read_cache is None:
+            return None
+        b, e = plan.extents[k]
+        try:
+            data = await self._read_cache.try_read_range(plan.path, b, e)
+        except Exception:  # noqa: BLE001 - probe is advisory
+            return None
+        if data is not None and len(data) == e - b:
+            return data
+        return None
+
     async def cache_populate(self, plan: ObjectPlan, buf: bytearray) -> None:
         if self._read_cache is not None:
             await self._read_cache.populate_object(plan.path, bytes(buf))
+
+    async def cache_populate_ranges(
+        self, plan: ObjectPlan, buf: bytearray, have: List[bool]
+    ) -> None:
+        """Land each contiguous run of held chunks in the cache's sparse
+        (chunk-granular) tier — a reshard rank holds only its needed
+        chunks, and the next reshard on this host serves them locally."""
+        if self._read_cache is None or not hasattr(
+            self._read_cache, "populate_range"
+        ):
+            return
+        n = len(plan.extents)
+        k = 0
+        while k < n:
+            if not have[k]:
+                k += 1
+                continue
+            j = k
+            while j < n and have[j]:
+                j += 1
+            b = plan.extents[k][0]
+            e = plan.extents[j - 1][1]
+            await self._read_cache.populate_range(
+                plan.path, b, e, bytes(buf[b:e])
+            )
+            k = j
 
     async def peer_serve_fault(self, plan: ObjectPlan, k: int, payload: bytearray) -> None:
         """The chaos hook: drive the ``peer_serve`` fault point (if a
@@ -437,6 +628,7 @@ def run_swarm(
     event_loop: asyncio.AbstractEventLoop,
     executor=None,
     digests: Optional[Dict[str, object]] = None,
+    need_maps: Optional[Dict[str, List[frozenset]]] = None,
 ) -> None:
     """Execute the swarm phase for one stateful's eligible entries.
 
@@ -446,7 +638,15 @@ def run_swarm(
     rank's assigned chunks fetch from origin concurrently (capped by
     ``TORCHSNAPSHOT_TPU_SWARM_FANOUT``) and post for peers the moment they
     land, while the wanted chunks fill from peers' fenced store keys with
-    per-chunk deadline / re-election / direct-origin fallback."""
+    per-chunk deadline / re-election / direct-origin fallback.
+
+    ``need_maps`` (path → per-chunk rank frozensets, ``plan_reshard_need``)
+    makes the exchange need-aware: a rank touches only the chunks its
+    exact-overlap plan needs, a chunk needed by ONE rank is a plain direct
+    read (zero store traffic), and a replicated-overlap chunk needed by K
+    ranks is origin-fetched by exactly one of them and swapped peer-to-peer
+    — the reshard case. Ack quorums shrink to the need-set size so the
+    store-side GC still fires."""
     if not items:
         return
     if not LAST_RESTORE_SWARM:
@@ -457,17 +657,30 @@ def run_swarm(
     session = _SwarmSession(coord, storage, executor, verify)
 
     # Deterministic (identical on every rank) object order; the index IS
-    # part of the store-key fence.
+    # part of the store-key fence. Reshard items register their FULL
+    # location list (this rank's reqs may touch only some shards; peers'
+    # indices must still line up), replicated items derive paths from
+    # their reqs (identical everywhere by construction).
     paths: List[str] = []
     for item in items:
-        for req in item.reqs:
-            if req.path not in paths:
-                paths.append(req.path)
-    plans = plan_objects(paths, digests, world)
+        for p in (
+            item.paths
+            if item.paths is not None
+            else [req.path for req in item.reqs]
+        ):
+            if p not in paths:
+                paths.append(p)
+    plans = plan_objects(paths, digests, world, need_maps)
     path_idx = {p.path: i for i, p in enumerate(plans)}
 
     # Item completion: finalize an item the moment its last req consumed.
+    # A reshard item with no local reqs (a rank holding no addressable
+    # shard of the target) finalizes immediately — it still registered its
+    # paths above so peers' object indices line up.
     item_pending = [len(item.reqs) for item in items]
+    for item in items:
+        if not item.reqs and item.finalize is not None:
+            item.finalize()
     # path -> [(item_index, req)] mapping for delivery.
     deliveries: Dict[str, List[Tuple[int, ReadReq]]] = {}
     for i, item in enumerate(items):
@@ -479,7 +692,15 @@ def run_swarm(
     max_attempts = 1 + min(knobs.get_bcast_reelect_max(), world - 1)
     poll_s = max(0.01, min(0.05, deadline_s / 10.0))
 
-    total_chunks = sum(len(p.extents) for p in plans)
+    def needed_chunks(plan: ObjectPlan) -> List[int]:
+        if plan.need is None:
+            return list(range(len(plan.extents)))
+        return [k for k in range(len(plan.extents)) if rank in plan.need[k]]
+
+    # This RANK's denominator: the chunks its plan needs (all of them in
+    # the replicated case) — what the tracker, LAST_RESTORE_SWARM["chunks"]
+    # and the chunks == origin+peer+cache identity count.
+    total_chunks = sum(len(needed_chunks(p)) for p in plans)
     tracker = telemetry.ProgressTracker()
     tracker.set_totals(requests=total_chunks, bytes_=0)
     pending_count = [total_chunks]
@@ -509,12 +730,20 @@ def run_swarm(
 
     async def restore_object(plan: ObjectPlan, obj: int) -> None:
         n = len(plan.extents)
+        need = plan.need
+        needed = needed_chunks(plan)
+        if not needed:
+            return  # nothing of this object overlaps this rank's targets
+
+        def quorum(k: int) -> int:
+            return world if need is None else len(need[k])
+
         buf = bytearray(plan.size)
         have = [False] * n
 
         # Warm-host shortcut: the read cache already holds the verified
-        # content — every chunk is local. This rank still SERVES its
-        # assigned chunks below (peers must never wait on a cache-hit
+        # content — every needed chunk is local. This rank still SERVES
+        # its assigned chunks below (peers must never wait on a cache-hit
         # rank), it just reads zero origin bytes doing so. Per-rank cache
         # state never changes the collective plan: serves and acks are
         # identical either way.
@@ -522,17 +751,42 @@ def run_swarm(
         if cached is not None:
             buf[:] = cached
             have = [True] * n
-            for k in range(n):
+            for k in needed:
                 _note_chunk(plan.path, "cache", plan.extents[k][1] - plan.extents[k][0])
+        elif need is not None:
+            # Reshard warm probe: the sparse cache tier may hold exactly
+            # the chunk runs a previous reshard on this host needed.
+            for k in needed:
+                data = await session.cache_probe_range(plan, k)
+                if data is not None:
+                    b, e = plan.extents[k]
+                    buf[b:e] = data
+                    have[k] = True
+                    _note_chunk(plan.path, "cache", e - b)
 
-        assigned = [k for k in range(n) if plan.orders[k][0] == rank]
+        assigned = [
+            k for k in needed if quorum(k) > 1 and plan.orders[k][0] == rank
+        ]
         sem = asyncio.Semaphore(fanout)
         acked = set()
 
         async def ack_once(k: int) -> None:
-            if k not in acked:
+            # Solo chunks never touch the store: nothing to ack or GC.
+            if k not in acked and quorum(k) > 1:
                 acked.add(k)
-                await session.ack(obj, k, max_attempts)
+                await session.ack(obj, k, max_attempts, quorum(k))
+
+        async def fetch_solo(k: int) -> None:
+            async with sem:
+                data = await origin_fetch(plan, obj, k)
+                b, e = plan.extents[k]
+                buf[b:e] = data
+                have[k] = True
+
+        # Chunks only THIS rank needs: plain direct reads, concurrent with
+        # the serves below — the disjoint part of a reshard costs exactly
+        # its overlap bytes and zero coordination.
+        solo_mine = [k for k in needed if quorum(k) <= 1 and not have[k]]
 
         async def serve_chunk(k: int) -> None:
             async with sem:
@@ -563,16 +817,24 @@ def run_swarm(
                     )
                     await session.post(obj, k, 0, _ERR + repr(e).encode())
 
-        await asyncio.gather(*(serve_chunk(k) for k in assigned))
+        await asyncio.gather(
+            *(serve_chunk(k) for k in assigned),
+            *(fetch_solo(k) for k in solo_mine),
+        )
         for k in assigned:
             if have[k]:
                 await ack_once(k)
 
-        # Peer-to-peer fill of everything this rank doesn't hold yet
-        # (wanted chunks, plus any assigned chunk whose serve failed).
-        wanted = [k for k in range(n) if not have[k]]
+        # Peer-to-peer fill of everything this rank needs but doesn't hold
+        # yet (wanted chunks, plus any assigned chunk whose serve failed).
+        wanted = [k for k in needed if not have[k]]
         attempt = {k: 0 for k in wanted}
         deadline = {k: time.monotonic() + deadline_s for k in wanted}
+
+        def att_max(k: int) -> int:
+            # Orders are restricted to the chunk's need set; past its end
+            # re-election would wrap onto already-dead servers.
+            return 1 + min(knobs.get_bcast_reelect_max(), len(plan.orders[k]) - 1)
 
         async def take_direct(k: int, why: str) -> None:
             telemetry.counter_add("swarm.direct_fallbacks")
@@ -647,7 +909,7 @@ def run_swarm(
                 if payload is None:
                     if now < deadline[k]:
                         continue
-                    if attempt[k] + 1 < max_attempts:
+                    if attempt[k] + 1 < att_max(k):
                         telemetry.counter_add("swarm.reelections")
                         LAST_RESTORE_SWARM["reelections"] += 1
                         logger.warning(
@@ -708,15 +970,21 @@ def run_swarm(
                 await asyncio.sleep(poll_s)
 
         # Cache-held chunks this rank neither served nor waited for still
-        # need their ack — every rank acks every chunk exactly once, so the
-        # LAST acker can GC the chunk's payload keys eagerly.
-        for k in range(n):
+        # need their ack — every need-set member acks every shared chunk
+        # exactly once, so the LAST acker can GC the chunk's payload keys
+        # eagerly.
+        for k in needed:
             await ack_once(k)
 
         # Assembled: land it in the read cache (digest-keyed — the next
-        # restore on this host reads zero origin AND zero peer bytes),
-        # then feed the consumers and finalize completed items.
-        await session.cache_populate(plan, buf)
+        # restore on this host reads zero origin AND zero peer bytes). A
+        # reshard rank holds only its needed chunks: those land in the
+        # cache's sparse chunk tier instead. Then feed the consumers and
+        # finalize completed items.
+        if all(have):
+            await session.cache_populate(plan, buf)
+        else:
+            await session.cache_populate_ranges(plan, buf, have)
         view = memoryview(buf)
         for item_index, req in deliveries.get(plan.path, []):
             if req.byte_range is not None:
