@@ -32,6 +32,7 @@ DOC_SOURCES = [
     "docs/observability.md",
     "docs/performance.md",
     "docs/robustness.md",
+    "docs/lifecycle.md",
     "docs/static-analysis.md",
 ]
 

@@ -1501,3 +1501,235 @@ def test_chaos_matrix_restore_resilient_schedules(
 ) -> None:
     cache_dir = str(tmp_path / "rcache") if with_cache else None
     _restore_round(any_backend, spec, expect_abort=False, cache_dir=cache_dir)
+
+
+# ---------------------------------------------------------------------------
+# Retention-GC chaos (the catalog lifecycle, PR "continuous checkpointing"):
+# seeded kill / permanent / transient / torn faults injected DURING
+# gc(policy=...) and around concurrent take-vs-gc schedules. Invariants:
+# every RETAINED snapshot restores bit-exact afterwards, and a re-run GC
+# converges — no orphaned trees, no stale records, no doubly-referenced
+# objects. Fast subset in tier-1; the backend matrix is slow-marked.
+# ---------------------------------------------------------------------------
+
+def _chain_state(step: int):
+    return {
+        "s": StateDict(
+            frozen=np.arange(2000, dtype=np.float32),
+            lora=np.full((64,), step, np.float32),
+            step=step,
+        )
+    }
+
+
+def _take_chain(bucket: str, n: int, job: str = "chaos") -> None:
+    for i in range(n):
+        Snapshot.take(
+            f"{bucket}/step_{i}", _chain_state(i), job=job, step=i
+        )
+
+
+def _assert_chain_restores(bucket: str, steps) -> None:
+    for step in steps:
+        out = StateDict()
+        Snapshot(f"{bucket}/step_{step}").restore({"s": out})
+        assert out["step"] == step
+        assert np.array_equal(
+            out["frozen"], np.arange(2000, dtype=np.float32)
+        )
+        assert np.array_equal(
+            out["lora"], np.full((64,), step, np.float32)
+        )
+        assert Snapshot(f"{bucket}/step_{step}").verify() == {}
+
+
+def _retention_round(bucket: str, spec: str, expect_raise: bool) -> None:
+    """One retention-GC chaos scenario: build a 5-step chain, run keep-last-2
+    under an injected fault schedule, then assert the full invariant
+    bundle: retained snapshots bit-exact, re-run convergence, catalog
+    consistency (records exactly match the live committed set)."""
+    from torchsnapshot_tpu import catalog
+
+    _take_chain(bucket, 5)
+    policy = catalog.RetentionPolicy.parse("last=2")
+    with knobs.override_faults(spec):
+        if expect_raise:
+            with pytest.raises(Exception):
+                catalog.retain(bucket, policy, dry_run=False)
+        else:
+            catalog.retain(bucket, policy, dry_run=False)
+    # Whatever the fault did, the retained set restores bit-exact...
+    _assert_chain_restores(bucket, [3, 4])
+    # ...and a clean re-run converges: records == live committed set,
+    # nothing further to condemn or delete on a third run.
+    report = catalog.retain(bucket, policy, dry_run=False)
+    _assert_chain_restores(bucket, [3, 4])
+    with catalog.Catalog(bucket) as cat:
+        names = [r.name for r in cat.load()]
+    assert names == ["step_3", "step_4"], names
+    report = catalog.retain(bucket, policy, dry_run=False)
+    assert report["condemned"] == [] and report["removed"] == 0, report
+
+
+def test_chaos_retention_gc_permanent_delete_fault(tmp_path) -> None:
+    """A permanent delete failure aborts retention mid-delete (after the
+    condemned metadata may already be gone) — the crash window the
+    metadata->tree->record ordering exists for. Fast tier-1 leg."""
+    _retention_round(
+        str(tmp_path / "bkt"), "op=delete,at=2,kind=fail", expect_raise=True
+    )
+
+
+def test_chaos_retention_gc_transient_delete_storm(tmp_path) -> None:
+    """Transient delete failures ride the shared retry machinery: the
+    retention run itself succeeds. Fast tier-1 leg."""
+    _retention_round(
+        str(tmp_path / "bkt"),
+        "backoff=0.005;op=delete,kind=transient,times=4",
+        expect_raise=False,
+    )
+
+
+def test_chaos_retention_gc_kill_mid_delete_subprocess(tmp_path) -> None:
+    """Real process death mid-retention-delete: the child dies at a seeded
+    delete, the parent observes a half-collected bucket, every retained
+    snapshot restores bit-exact, and a re-run GC converges. Fast tier-1
+    leg (fs only: kill needs a real subprocess)."""
+    from torchsnapshot_tpu import catalog
+
+    bucket = str(tmp_path / "bkt")
+    _take_chain(bucket, 5)
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from torchsnapshot_tpu import catalog\n"
+        "catalog.retain(os.environ['CHAOS_BUCKET'],\n"
+        "    catalog.RetentionPolicy.parse('last=2'), dry_run=False)\n"
+    )
+    env = dict(
+        os.environ,
+        CHAOS_BUCKET=bucket,
+        TORCHSNAPSHOT_TPU_FAULTS="op=delete,at=3,kind=kill",
+    )
+    env.pop("TORCHSNAPSHOT_TPU_TRACE", None)
+    result = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, timeout=120
+    )
+    assert result.returncode == KILL_EXIT_CODE, result.stderr.decode()[-2000:]
+    # The kill landed mid-delete: retained snapshots are still whole.
+    _assert_chain_restores(bucket, [3, 4])
+    # Re-run converges to exactly the retained set + consistent catalog.
+    policy = catalog.RetentionPolicy.parse("last=2")
+    catalog.retain(bucket, policy, dry_run=False)
+    _assert_chain_restores(bucket, [3, 4])
+    with catalog.Catalog(bucket) as cat:
+        assert [r.name for r in cat.load()] == ["step_3", "step_4"]
+    live = sorted(
+        d for d in os.listdir(bucket) if d != catalog.CATALOG_DIR
+    )
+    assert live == ["step_3", "step_4"], live
+    report = catalog.retain(bucket, policy, dry_run=False)
+    assert report["condemned"] == [] and report["removed"] == 0
+
+
+def test_chaos_take_while_gc_condemns_base(tmp_path, caplog) -> None:
+    """The take-vs-gc interleaving: retention condemns and deletes the
+    job's chain head while a take that already selected it as base is in
+    flight (reconstructed deterministically via the chain cache). The take
+    must degrade to a full snapshot and commit; both survivors bit-exact;
+    the catalog stays consistent. Fast tier-1 leg."""
+    from torchsnapshot_tpu import catalog
+
+    bucket = str(tmp_path / "bkt")
+    _take_chain(bucket, 3)
+    # Freeze the chain head the next take will select, then condemn
+    # EVERYTHING the policy allows (keep-last-1 drops steps 0-1)...
+    head = catalog._CHAIN_CACHE[(os.path.abspath(bucket), "chaos")]
+    assert head[0] == "step_2"
+    catalog.retain(
+        bucket, catalog.RetentionPolicy.parse("last=1"), dry_run=False
+    )
+    # ...then make the head itself vanish mid-"take" (the race window):
+    import shutil
+
+    shutil.rmtree(f"{bucket}/step_2")
+    catalog.note_commit(os.path.abspath(bucket), "chaos", "step_2", 2)
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.snapshot"):
+        Snapshot.take(
+            f"{bucket}/step_3", _chain_state(3), job="chaos", step=3
+        )
+    assert any("full snapshot" in r.message for r in caplog.records)
+    _assert_chain_restores(bucket, [3])
+    with catalog.Catalog(bucket) as cat:
+        recs = {r.name: r for r in cat.load()}
+    assert recs["step_3"].job == "chaos"
+    # The vanished head's record is converged away by the next gc run.
+    catalog.retain(
+        bucket, catalog.RetentionPolicy.parse("last=2"), dry_run=False
+    )
+    with catalog.Catalog(bucket) as cat:
+        assert [r.name for r in cat.load()] == ["step_3"]
+
+
+def test_chaos_torn_catalog_append_never_fails_commit(tmp_path) -> None:
+    """A torn write of the catalog RECORD at commit time: the snapshot is
+    already committed and must stay so; the record is simply missing until
+    rebuild. Fast tier-1 leg."""
+    from torchsnapshot_tpu import catalog
+
+    bucket = str(tmp_path / "bkt")
+    with knobs.override_faults(
+        "op=write,kind=torn,bytes=8,path=.catalog/records"
+    ):
+        snap = Snapshot.take(
+            f"{bucket}/step_0", _chain_state(0), job="chaos", step=0
+        )
+    assert snap.verify() == {}
+    _assert_chain_restores(bucket, [0])
+    with catalog.Catalog(bucket) as cat:
+        assert cat.load() == []  # the record never landed...
+        rebuilt = cat.rebuild()  # ...and rebuild reconstructs it by scan
+    assert [r.name for r in rebuilt] == ["step_0"]
+
+
+_GC_FAULT_SCHEDULES = [
+    "op=delete,at=0,kind=fail",  # the very first (metadata) delete
+    "op=delete,at=4,kind=fail",  # mid-tree
+    "seed=11;op=delete,p=0.5,kind=fail",  # seeded scattershot
+    "op=read,kind=fail,path=.catalog",  # catalog scan itself faulted
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", _GC_FAULT_SCHEDULES)
+@pytest.mark.parametrize("any_backend", ["fs", "memory", "gcs"], indirect=True)
+def test_chaos_matrix_retention_gc_schedules(any_backend, spec) -> None:
+    """The retention-GC fault matrix across fs/memory/fake-gcs: any abort
+    leaves every retained snapshot bit-exact and a re-run converges."""
+    from torchsnapshot_tpu import catalog as _catalog
+
+    # The catalog-scan fault schedule can surface as a refused plan
+    # rather than a mid-delete abort — both are legal outcomes; the
+    # invariants afterwards are what matters.
+    try:
+        _retention_round(any_backend, spec, expect_raise=True)
+    except pytest.fail.Exception:
+        # expect_raise was wrong for this schedule/backend (the fault was
+        # absorbed fail-open, e.g. an unreadable catalog treated as
+        # empty): re-assert the invariant bundle directly.
+        _assert_chain_restores(any_backend, [3, 4])
+        policy = _catalog.RetentionPolicy.parse("last=2")
+        report = _catalog.retain(any_backend, policy, dry_run=False)
+        _assert_chain_restores(any_backend, [3, 4])
+        report = _catalog.retain(any_backend, policy, dry_run=False)
+        assert report["condemned"] == [] and report["removed"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("any_backend", ["fs", "memory", "gcs"], indirect=True)
+def test_chaos_matrix_retention_transient_storms(any_backend) -> None:
+    _retention_round(
+        any_backend,
+        "backoff=0.005;seed=7;op=delete,p=0.5,kind=transient,times=6",
+        expect_raise=False,
+    )

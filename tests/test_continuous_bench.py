@@ -1,0 +1,110 @@
+"""Continuous-checkpointing benchmark harness: fast tier-1 smoke + the
+slow acceptance-scale lane.
+
+The smoke proves the lifecycle loop end to end at a tiny size: catalog-
+managed delta chains (auto-base + rebase-to-full), keep-last-K retention
+bounding bucket bytes while snapshot count grows, and the chain-aware warm
+restore reading ≈ only the newest delta's new bytes from origin. The
+slow-marked run — registered in pre_commit.yaml's slow lane, under the
+budget-ledger and collective-lockstep sanitizers — is the acceptance-scale
+leg: ≥ 50 sustained snapshots with a plateaued bucket."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_bench(
+    steps: int,
+    keep_last: int,
+    retain_every: int,
+    max_chain: int,
+    frozen_mb: int,
+    adapter_mb: int,
+    timeout: int = 420,
+    extra_env: dict = None,
+) -> dict:
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "JAX_PLATFORMS": "cpu",
+        "CONTINUOUS_BENCH_STEPS": str(steps),
+        "CONTINUOUS_BENCH_KEEP_LAST": str(keep_last),
+        "CONTINUOUS_BENCH_RETAIN_EVERY": str(retain_every),
+        "CONTINUOUS_BENCH_MAX_CHAIN": str(max_chain),
+        "CONTINUOUS_BENCH_FROZEN_MB": str(frozen_mb),
+        "CONTINUOUS_BENCH_ADAPTER_MB": str(adapter_mb),
+    }
+    env.update(extra_env or {})
+    out = subprocess.run(
+        [sys.executable, "benchmarks/continuous/main.py"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-3000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _check(result: dict) -> None:
+    d = result["detail"]
+    assert d["problems"] == [], d["problems"]
+    assert result["value"] > 0
+    # Retention kept the live window bounded: records pruned to ~keep_last
+    # (+1 race slack for takes landing between retention passes).
+    assert d["records_live"] <= d["keep_last"] + d["retain_every"]
+    # The chain actually chained AND rebased: deltas deeper than 0 were
+    # taken, and no recorded chain exceeds the cap.
+    assert 0 < d["max_chain_seen"] <= d["max_chain_len"]
+    # Bounded growth: final bucket within the retained-window bound.
+    assert d["bucket_bytes_final"] <= d["window_bound_bytes"]
+    # Chain-aware warm restore: origin traffic ≈ the delta's new bytes,
+    # and the chain-shared backbone came from the cache.
+    warm = d["warm_restore"]
+    assert warm["bit_exact"]
+    assert warm["origin_bytes"] <= warm["delta_budget_bytes"]
+    assert warm["cache_bytes"] > warm["origin_bytes"]
+
+
+def test_continuous_bench_smoke() -> None:
+    result = _run_bench(
+        steps=8,
+        keep_last=2,
+        retain_every=3,
+        max_chain=3,
+        frozen_mb=4,
+        adapter_mb=1,
+    )
+    _check(result)
+    assert result["detail"]["plateau_ratio"] <= 1.25
+
+
+@pytest.mark.slow
+def test_continuous_bench_sustained_50_snapshots() -> None:
+    """Acceptance criteria: ≥ 50 sustained incremental snapshots, bucket
+    bytes plateaued by keep-last-K, warm restore of the newest step reading
+    only that delta's new bytes from origin."""
+    result = _run_bench(
+        steps=54,
+        keep_last=5,
+        retain_every=5,
+        max_chain=8,
+        frozen_mb=32,
+        adapter_mb=2,
+        timeout=900,
+        extra_env={
+            "TORCHSNAPSHOT_TPU_DEBUG_LEDGER": "1",
+            "TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES": "1",
+        },
+    )
+    _check(result)
+    d = result["detail"]
+    assert d["steps"] >= 50
+    assert d["plateau_ratio"] <= 1.25, d["bucket_bytes_series"]
+    # Chains rebased to full on cadence: more than one full take lives in
+    # (or was pruned through) the bucket over 50+ steps at max_chain=8.
+    assert d["max_chain_seen"] == d["max_chain_len"]

@@ -273,6 +273,180 @@ def test_multirank_incremental_dedup(tmp_path) -> None:
     )
 
 
+# ---------------------------------------------------------------------------
+# The base= fallback ladder (snapshot.py): every degrade branch must fall
+# back (to a full snapshot, or to degraded dedup) WITH its warning — a
+# silent degrade would report bogus incremental "speedups" while rewriting
+# every byte. One parametrized case per branch.
+# ---------------------------------------------------------------------------
+
+def _ladder_no_dedup_knob(tmp_path):
+    """Branch: dedup digests off at take time -> base ignored outright."""
+    base = str(tmp_path / "base")
+    Snapshot.take(base, {"m": _state(0)})
+    ctx = knobs.override_dedup_digests(False)
+    return base, ctx, "ignored: incremental dedup requires"
+
+
+def _ladder_unusable_url(tmp_path):
+    """Branch: base URL unparseable/unsupported -> unusable."""
+    return "foo://not/a/thing", None, "is unusable"
+
+
+def _ladder_no_metadata(tmp_path):
+    """Branch: base tree exists but was never committed."""
+    base = str(tmp_path / "base")
+    os.makedirs(base)
+    with open(os.path.join(base, "junk"), "w") as f:
+        f.write("x")
+    return base, None, "has no committed metadata"
+
+
+def _ladder_unreadable_sidecars(tmp_path):
+    """Branch: committed base whose checksum sidecar is corrupt JSON."""
+    base = str(tmp_path / "base")
+    Snapshot.take(base, {"m": _state(0)})
+    with open(os.path.join(base, ".checksums.0"), "w") as f:
+        f.write("{torn")
+    return base, None, "checksum sidecars unreadable"
+
+
+def _ladder_no_sha_identities(tmp_path):
+    """Branch: sidecars present but recorded without sha256 identities."""
+    import json
+
+    base = str(tmp_path / "base")
+    Snapshot.take(base, {"m": _state(0)})
+    sidecar_path = os.path.join(base, ".checksums.0")
+    with open(sidecar_path) as f:
+        sidecar = json.load(f)
+    stripped = {}
+    for k, v in sidecar.items():
+        if isinstance(v, list):
+            stripped[k] = [v[0], v[1], None]
+        elif isinstance(v, dict):
+            stripped[k] = [v["crc"], v["size"], None]
+        else:
+            stripped[k] = v
+    with open(sidecar_path, "w") as f:
+        json.dump(stripped, f)
+    return base, None, "carries no sha256 dedup identities"
+
+
+@pytest.mark.parametrize(
+    "make_base",
+    [
+        _ladder_no_dedup_knob,
+        _ladder_unusable_url,
+        _ladder_no_metadata,
+        _ladder_unreadable_sidecars,
+        _ladder_no_sha_identities,
+    ],
+    ids=[
+        "no-dedup-knob",
+        "unusable-url",
+        "no-committed-metadata",
+        "unreadable-sidecars",
+        "no-sha-identities",
+    ],
+)
+def test_base_fallback_ladder_full_snapshot(tmp_path, caplog, make_base) -> None:
+    """Each degrade branch: the take SUCCEEDS as a full snapshot (no hard
+    links, zero deduped bytes) and logs its specific warning."""
+    import contextlib
+
+    base, ctx, expected_warning = make_base(tmp_path)
+    inc = str(tmp_path / "inc")
+    with ctx if ctx is not None else contextlib.nullcontext():
+        with caplog.at_level("WARNING", logger="torchsnapshot_tpu.snapshot"):
+            Snapshot.take(inc, {"m": _state(0)}, base=base)
+    assert any(expected_warning in r.message for r in caplog.records), (
+        expected_warning,
+        [r.message for r in caplog.records],
+    )
+    # Full, not incremental: fresh inodes for every object.
+    base_obj = os.path.join(base, "0", "m", "frozen0")
+    inc_obj = os.path.join(inc, "0", "m", "frozen0")
+    if os.path.exists(base_obj):
+        assert os.stat(base_obj).st_ino != os.stat(inc_obj).st_ino
+    # ...and correct.
+    out = StateDict()
+    Snapshot(inc).restore({"m": out})
+    assert out["step"] == 0
+    assert np.array_equal(out["frozen1"], np.arange(1000, dtype=np.float32) + 1)
+    assert Snapshot(inc).verify() == {}
+
+
+def test_base_fallback_codec_version_mismatch_warns(tmp_path, caplog) -> None:
+    """Branch: the base compressed with a different codec library version —
+    dedup is still ATTEMPTED (identical bitstreams may exist) but the
+    likely-miss is surfaced, never silent."""
+    import json
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    with knobs.override_compression("zlib"):
+        Snapshot.take(base, {"m": _state(0)})
+        meta_path = os.path.join(base, ".snapshot_metadata")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta["codec_versions"] = {"zlib": "0.0.not-this-one"}
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        with caplog.at_level("WARNING", logger="torchsnapshot_tpu.snapshot"):
+            Snapshot.take(inc, {"m": _state(0)}, base=base)
+    assert any(
+        "byte-identical dedup will likely miss" in r.message
+        for r in caplog.records
+    )
+    out = StateDict()
+    Snapshot(inc).restore({"m": out})
+    assert out["step"] == 0
+    assert Snapshot(inc).verify() == {}
+
+
+def test_base_fallback_mixed_coverage_warns_and_partially_dedups(
+    tmp_path, caplog
+) -> None:
+    """Branch: some base objects carry sha identities and some don't
+    (heterogeneous hosts / knob churn): covered objects still hard-link,
+    uncovered ones rewrite, and the partial rewrite is surfaced."""
+    import json
+
+    base = str(tmp_path / "base")
+    inc = str(tmp_path / "inc")
+    Snapshot.take(base, {"m": _state(0)})
+    sidecar_path = os.path.join(base, ".checksums.0")
+    with open(sidecar_path) as f:
+        sidecar = json.load(f)
+    # Strip the sha identity from exactly one object.
+    victim = "0/m/frozen0"
+    assert victim in sidecar
+    v = sidecar[victim]
+    sidecar[victim] = (
+        [v[0], v[1], None]
+        if isinstance(v, list)
+        else [v["crc"], v["size"], None]
+    )
+    with open(sidecar_path, "w") as f:
+        json.dump(sidecar, f)
+    with caplog.at_level("WARNING", logger="torchsnapshot_tpu.snapshot"):
+        Snapshot.take(inc, {"m": _state(0)}, base=base)
+    assert any(
+        "carry no sha256 dedup identity" in r.message for r in caplog.records
+    )
+    # The stripped object was rewritten; a covered one still hard-links.
+    assert (
+        os.stat(os.path.join(base, victim)).st_ino
+        != os.stat(os.path.join(inc, victim)).st_ino
+    )
+    assert (
+        os.stat(os.path.join(base, "0", "m", "frozen1")).st_ino
+        == os.stat(os.path.join(inc, "0", "m", "frozen1")).st_ino
+    )
+    assert Snapshot(inc).verify() == {}
+
+
 def test_auto_gate_single_core_writes_crc_only_sidecars(tmp_path, monkeypatch) -> None:
     """The round-5 default on a single-core host: takes still write checksum
     sidecars (verify() stays green) but with no sha256 — the dedup identity

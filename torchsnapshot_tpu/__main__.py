@@ -32,12 +32,24 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         from the storage plugin itself, so what you see is what a restore
         pays per request.
 
-    python -m torchsnapshot_tpu gc <path> [--apply]
+    python -m torchsnapshot_tpu gc <path> [--apply] [--policy SPEC]
         Reclaim crash debris: whole uncommitted snapshot trees (no
         ``.snapshot_metadata`` — invisible to readers by the atomic-commit
         contract) and files a committed manifest does not reference (temp
         files and data objects of torn takes). Dry-run by default; --apply
-        deletes. See docs/robustness.md.
+        deletes. With ``--policy`` (e.g. ``last=5,hourly=24``) the run is
+        RETENTION-driven instead: snapshots the bucket's catalog records
+        that the per-job policy drops are condemned and collected whole
+        (crash-convergent deletion order; pins always survive; in-flight
+        takes untouched). See docs/robustness.md and docs/lifecycle.md.
+
+    python -m torchsnapshot_tpu catalog {ls,pin,unpin,retain,rebuild} ...
+        The bucket's snapshot catalog (docs/lifecycle.md): ``ls`` lists
+        committed snapshots with their job, step, delta-chain shape and
+        byte attribution; ``pin``/``unpin`` exempt a snapshot from every
+        retention policy; ``retain --policy SPEC [--apply]`` applies a
+        policy; ``rebuild`` reconstructs missing records by scanning the
+        bucket (the catalog is advisory — scan-reconstructable by design).
 
     python -m torchsnapshot_tpu stats <snapshot-path> [--trace out.json]
         Fleet view from the persisted ``.telemetry/rank_*.json`` artifacts
@@ -227,6 +239,15 @@ def _cmd_trace(args: argparse.Namespace) -> int:
 def _cmd_gc(args: argparse.Namespace) -> int:
     from .snapshot import Snapshot
 
+    if args.policy is not None:
+        from . import catalog as catalog_mod
+
+        report = catalog_mod.retain(
+            args.path,
+            catalog_mod.RetentionPolicy.parse(args.policy),
+            dry_run=not args.apply,
+        )
+        return _print_retention_report(report, apply=args.apply)
     report = Snapshot.gc(args.path, dry_run=not args.apply)
     for root in report["committed"]:
         print(f"committed: {root or '.'}")
@@ -241,6 +262,82 @@ def _cmd_gc(args: argparse.Namespace) -> int:
         f"{'removed' if args.apply else 'found (dry run; pass --apply to delete)'}"
     )
     return 0
+
+
+def _print_retention_report(report, apply: bool) -> int:
+    policy = report["policy"]
+    for name in policy["retained"]:
+        pin = " [pinned]" if name in policy["pinned"] else ""
+        print(f"retained: {name}{pin}")
+    verb = "condemned (deleted)" if apply else "condemned (dry run)"
+    for name in policy["condemned"]:
+        print(f"{verb}: {name}")
+    print(
+        f"{len(policy['retained'])} snapshot(s) retained, "
+        f"{len(policy['condemned'])} condemned, "
+        f"{report['removed'] if apply else len(report['remove'])} file(s) "
+        f"{'removed' if apply else 'to remove (dry run; pass --apply to delete)'}"
+    )
+    return 0
+
+
+def _cmd_catalog(args: argparse.Namespace) -> int:
+    import json
+
+    from . import catalog as catalog_mod
+
+    if args.catalog_cmd == "ls":
+        with catalog_mod.Catalog(args.path) as cat:
+            records = cat.load(job=args.job)
+            pins = cat.pins()
+        if args.json:
+            print(
+                json.dumps(
+                    [json.loads(r.to_json()) for r in records], indent=2
+                )
+            )
+            return 0
+        if not records:
+            print("no catalog records (run `catalog rebuild` to scan)")
+            return 0
+        for r in records:
+            base = f" base={r.base} chain={r.chain_len}" if r.base else " full"
+            pin = " [pinned]" if r.name in pins else ""
+            attr = (
+                f" {r.bytes_total / 1e6:.1f} MB"
+                f" ({r.bytes_written / 1e6:.1f} new)"
+                if r.bytes_total
+                else ""
+            )
+            print(
+                f"{r.name}  job={r.job or '-'} step={r.step}{base}{attr}{pin}"
+            )
+        return 0
+    if args.catalog_cmd == "pin":
+        with catalog_mod.Catalog(args.path) as cat:
+            cat.pin(args.name)
+        print(f"pinned: {args.name}")
+        return 0
+    if args.catalog_cmd == "unpin":
+        with catalog_mod.Catalog(args.path) as cat:
+            existed = cat.unpin(args.name)
+        print(f"unpinned: {args.name}" if existed else f"not pinned: {args.name}")
+        return 0
+    if args.catalog_cmd == "rebuild":
+        with catalog_mod.Catalog(args.path) as cat:
+            written = cat.rebuild()
+        for r in written:
+            print(f"reconstructed: {r.name} (step {r.step})")
+        print(f"{len(written)} record(s) reconstructed")
+        return 0
+    if args.catalog_cmd == "retain":
+        report = catalog_mod.retain(
+            args.path,
+            catalog_mod.RetentionPolicy.parse(args.policy),
+            dry_run=not args.apply,
+        )
+        return _print_retention_report(report, apply=args.apply)
+    raise AssertionError(args.catalog_cmd)
 
 
 def _cmd_stats(args: argparse.Namespace) -> int:
@@ -372,7 +469,9 @@ def main(argv=None) -> int:
         "gc",
         help=(
             "reclaim crash debris: uncommitted snapshot trees and files "
-            "unreferenced by the committed manifest (dry-run by default)"
+            "unreferenced by the committed manifest (dry-run by default); "
+            "--policy runs retention-driven collection off the bucket's "
+            "snapshot catalog instead"
         ),
     )
     p_gc.add_argument("path")
@@ -381,7 +480,69 @@ def main(argv=None) -> int:
         action="store_true",
         help="actually delete the debris (default: dry-run report only)",
     )
+    p_gc.add_argument(
+        "--policy",
+        default=None,
+        metavar="SPEC",
+        help=(
+            "retention policy (e.g. 'last=5,hourly=24,daily=7'): condemn "
+            "cataloged snapshots the policy drops (per job; pins always "
+            "survive) instead of sweeping crash debris — safe to run "
+            "concurrently with takes. Grammar: docs/lifecycle.md"
+        ),
+    )
     p_gc.set_defaults(fn=_cmd_gc)
+
+    p_cat = sub.add_parser(
+        "catalog",
+        help=(
+            "the bucket's snapshot catalog: list committed snapshots and "
+            "their delta chains, pin/unpin, apply retention, or rebuild "
+            "records by scanning (docs/lifecycle.md)"
+        ),
+    )
+    cat_sub = p_cat.add_subparsers(dest="catalog_cmd", required=True)
+    p_cat_ls = cat_sub.add_parser(
+        "ls", help="list catalog records (chains, steps, byte attribution)"
+    )
+    p_cat_ls.add_argument("path", help="bucket (the snapshots' parent)")
+    p_cat_ls.add_argument("--job", default=None, help="filter by job id")
+    p_cat_ls.add_argument(
+        "--json", action="store_true", help="machine-readable records"
+    )
+    p_cat_pin = cat_sub.add_parser(
+        "pin", help="pin a snapshot: retained by every policy until unpinned"
+    )
+    p_cat_pin.add_argument("path", help="bucket (the snapshots' parent)")
+    p_cat_pin.add_argument("name", help="snapshot name (bucket-relative)")
+    p_cat_unpin = cat_sub.add_parser("unpin", help="remove a pin")
+    p_cat_unpin.add_argument("path")
+    p_cat_unpin.add_argument("name")
+    p_cat_rebuild = cat_sub.add_parser(
+        "rebuild",
+        help=(
+            "reconstruct missing records by scanning the bucket for "
+            "committed snapshots (job/base unknown on synthesized records)"
+        ),
+    )
+    p_cat_rebuild.add_argument("path")
+    p_cat_retain = cat_sub.add_parser(
+        "retain",
+        help=(
+            "apply a retention policy: report (and with --apply, collect) "
+            "the snapshots the policy condemns"
+        ),
+    )
+    p_cat_retain.add_argument("path")
+    p_cat_retain.add_argument(
+        "--policy", required=True, metavar="SPEC",
+        help="e.g. 'last=5,hourly=24,daily=7,job=trainer-*'",
+    )
+    p_cat_retain.add_argument(
+        "--apply", action="store_true",
+        help="actually delete condemned snapshots (default: dry-run)",
+    )
+    p_cat.set_defaults(fn=_cmd_catalog)
 
     p_stats = sub.add_parser(
         "stats",

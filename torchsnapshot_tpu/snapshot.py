@@ -354,6 +354,39 @@ def _abort_exception(
     return CheckpointAbortedError(path, rank, phase, repr(e))
 
 
+def _chain_len_for(plan: "TakePlan") -> int:
+    """Chain length a catalog-managed take records: 0 for a full snapshot,
+    base-chain + 1 when the base was catalog-auto-resolved (the preflight
+    broadcast carried its recorded chain length to every rank), and a
+    conservative 1 for an EXPLICIT user base (its chain, if any, is not
+    known SPMD-consistently — the rebase-to-full policy only governs
+    auto-selected chains anyway)."""
+    if not plan.base:
+        return 0
+    if plan.base_chain_len >= 0:
+        return plan.base_chain_len + 1
+    return 1
+
+
+def _note_chain_commit(plan: "TakePlan", job: str) -> None:
+    """Refresh the per-process chain cache on EVERY rank after a
+    catalog-managed commit, so the next same-job take auto-selects this
+    snapshot without storage I/O. Fail-open diagnostics-grade state."""
+    from . import catalog as catalog_mod
+
+    if not knobs.is_catalog_enabled():
+        return
+    try:
+        split = catalog_mod.split_bucket(plan.path)
+        if split is not None:
+            catalog_mod.note_commit(
+                split[0], job, split[1], _chain_len_for(plan)
+            )
+    except Exception:  # noqa: BLE001 - cache refresh must never fail a take
+        logger.debug("chain-cache refresh failed for %s", plan.path,
+                     exc_info=True)
+
+
 class Snapshot:
     """A reference to a persisted snapshot at ``path``.
 
@@ -391,6 +424,9 @@ class Snapshot:
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         base: Optional[str] = None,
+        job: Optional[str] = None,
+        step: Optional[int] = None,
+        max_chain_len: Optional[int] = None,
         _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "Snapshot":
         """``base``: path of an earlier snapshot for an INCREMENTAL take —
@@ -402,6 +438,18 @@ class Snapshot:
         checkpoints when most state is frozen (LoRA/partial finetunes,
         embedding-heavy models).
 
+        ``job``: opt into the per-bucket snapshot **catalog**
+        (``catalog.py``, docs/lifecycle.md): the committed snapshot is
+        recorded under ``<parent>/.catalog/`` (job id, ``step``, base
+        pointer, chain length, byte attribution), and — when ``base`` is
+        not given explicitly — the best base is auto-selected from the
+        catalog: the latest committed same-job snapshot, unless its chain
+        is already ``max_chain_len`` deltas deep (default:
+        ``TORCHSNAPSHOT_TPU_MAX_CHAIN_LEN``), in which case the take
+        REBASES to a full snapshot. ``step`` defaults to trailing digits
+        of the snapshot name. Selection happens on rank 0 inside the
+        preflight round, so every rank uses the same base by construction.
+
         ``_telemetry``: a :class:`telemetry.Telemetry` session to record
         this take's spans/metrics into (semi-public; the stable switch is
         the ``TORCHSNAPSHOT_TPU_TRACE`` knob). The session is also
@@ -409,6 +457,7 @@ class Snapshot:
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
         rank = coord.get_rank()
+        base = cls._maybe_auto_base(base, job, max_chain_len)
         tm, tm_prev = _begin_telemetry(_telemetry)
         try:
             plan = cls._plan_take(path, app_state, coord, replicated or [], base)
@@ -466,6 +515,23 @@ class Snapshot:
                         cls._write_snapshot_metadata(
                             metadata, storage, event_loop
                         )
+                        # Catalog append rides the commit, pre-barrier:
+                        # metadata is already visible (the record implies a
+                        # committed snapshot) and peers are still parked in
+                        # the barrier, so when take() returns on ANY rank
+                        # the bucket's catalog names this snapshot.
+                        # Fail-open by contract.
+                        if job is not None:
+                            cls._append_catalog_record(
+                                plan.path,
+                                storage,
+                                event_loop,
+                                world_size=metadata.world_size,
+                                job=job,
+                                step=step,
+                                base=plan.base,
+                                chain_len=_chain_len_for(plan),
+                            )
                     # ...and return only after the commit is visible:
                     # otherwise a non-zero rank could immediately open the
                     # path for restore and race rank 0's metadata write.
@@ -475,6 +541,8 @@ class Snapshot:
                         # let the coordinator collect collective keys
                         # posted before it.
                         coord.note_external_barrier()
+                if job is not None:
+                    _note_chain_commit(plan, job)
             except BaseException as e:
                 aborted = _abort_exception(plan.path, barrier, rank, phase, e)
                 if aborted is e:
@@ -497,6 +565,9 @@ class Snapshot:
         coordinator: Optional[Coordinator] = None,
         replicated: Optional[List[str]] = None,
         base: Optional[str] = None,
+        job: Optional[str] = None,
+        step: Optional[int] = None,
+        max_chain_len: Optional[int] = None,
         _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "PendingSnapshot":
         """Returns after planning + forking device buffers (milliseconds);
@@ -512,9 +583,15 @@ class Snapshot:
 
         A telemetry session (``_telemetry=`` or the TORCHSNAPSHOT_TPU_TRACE
         knob) stays active through the background drain and closes — and
-        the trace file is written — when the snapshot commits."""
+        the trace file is written — when the snapshot commits.
+
+        ``job``/``step``/``max_chain_len``: catalog-managed delta chains,
+        exactly as in :meth:`take`; the catalog record is appended by the
+        background commit thread, after metadata lands and before the
+        commit barrier releases."""
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
+        base = cls._maybe_auto_base(base, job, max_chain_len)
         tm, tm_prev = _begin_telemetry(_telemetry)
         try:
             plan = cls._plan_take(path, app_state, coord, replicated or [], base)
@@ -547,6 +624,11 @@ class Snapshot:
             tm=tm,
             tm_prev=tm_prev,
             phase_spans=plan.phase_tracker.spans if plan.phase_tracker else None,
+            catalog_info=(
+                (job, step, plan.base, _chain_len_for(plan))
+                if job is not None
+                else None
+            ),
         )
 
     @classmethod
@@ -653,6 +735,7 @@ class Snapshot:
             cache_hit=pf.hit,
             cached=cached if pf.hit else None,
             phase_tracker=tracker,
+            base_chain_len=pf.base_chain_len,
         )
 
     @classmethod
@@ -814,6 +897,93 @@ class Snapshot:
         LAST_TAKE_PHASES.clear()
         LAST_TAKE_PHASES.update(tracker.durations)
         return pending_io_work, metadata
+
+    @classmethod
+    def _maybe_auto_base(
+        cls,
+        base: Optional[str],
+        job: Optional[str],
+        max_chain_len: Optional[int],
+    ) -> Optional[str]:
+        """Plant the catalog auto-base sentinel for a ``job=`` take with no
+        explicit ``base=``: the preflight round resolves it on rank 0 (one
+        catalog reader per take, the result broadcast with the canonical
+        path) — see ``catalog.resolve_auto_base``. An explicit base always
+        wins; with the catalog knob off the take is a plain full take."""
+        if job is None or base is not None or not knobs.is_catalog_enabled():
+            return base
+        from . import catalog as catalog_mod
+
+        return catalog_mod.auto_base_token(
+            job,
+            max_chain_len
+            if max_chain_len is not None
+            else knobs.get_max_chain_len(),
+        )
+
+    @classmethod
+    def _append_catalog_record(
+        cls,
+        path: str,
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        world_size: int,
+        job: str,
+        step: Optional[int],
+        base: Optional[str],
+        chain_len: int,
+    ) -> None:
+        """Rank 0's commit-time catalog append (fail-open by contract: the
+        snapshot is already committed; a failed append only drops it from
+        the chain/retention view until ``catalog rebuild``). Byte
+        attribution is derived from the snapshot's own checksum sidecars
+        vs the base's — no collectives."""
+        if not knobs.is_catalog_enabled():
+            return
+        import re as _re
+
+        from . import catalog as catalog_mod
+
+        try:
+            split = catalog_mod.split_bucket(path)
+            if split is None:
+                logger.warning(
+                    "snapshot %s has no parent bucket; catalog record "
+                    "skipped", path,
+                )
+                return
+            bucket, name = split
+            total, written, deduped = catalog_mod.byte_attribution(
+                storage, world_size, base, event_loop
+            )
+            if step is None:
+                m = _re.search(r"(\d+)$", name)
+                step = int(m.group(1)) if m else -1
+            base_field = None
+            if base:
+                bsplit = catalog_mod.split_bucket(base)
+                base_field = (
+                    bsplit[1] if bsplit and bsplit[0] == bucket else base
+                )
+            record = catalog_mod.CatalogRecord(
+                name=name,
+                job=job,
+                step=int(step),
+                wall_time=time.time(),
+                base=base_field,
+                chain_len=chain_len,
+                world_size=world_size,
+                bytes_total=total,
+                bytes_written=written,
+                bytes_deduped=deduped,
+            )
+            with catalog_mod.Catalog(bucket, event_loop=event_loop) as cat:
+                cat.append(record)
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "catalog record for %s could not be appended (snapshot "
+                "commit unaffected)", path, exc_info=True,
+            )
 
     @classmethod
     def _load_base_digests(
@@ -2181,9 +2351,17 @@ class Snapshot:
 
     # -------------------------------------------------------------------- gc
     @classmethod
-    def gc(cls, path: str, dry_run: bool = True) -> Dict[str, Any]:
-        """Reclaim crash debris under ``path`` — uncommitted snapshot trees
-        and files a committed manifest does not reference.
+    def gc(
+        cls,
+        path: str,
+        dry_run: bool = True,
+        keep_roots: Optional[Set[str]] = None,
+        roots: Optional[List[str]] = None,
+        collect_debris: bool = True,
+    ) -> Dict[str, Any]:
+        """Garbage-collect under ``path`` — the ONE deletion path both the
+        whole-bucket crash-debris sweep and the catalog's retention engine
+        (``catalog.retain`` / ``gc --policy``) drive.
 
         ``path`` is either one snapshot root or a directory whose immediate
         children are snapshot roots (the usual ``/checkpoints/step_N``
@@ -2196,46 +2374,203 @@ class Snapshot:
         debris in its entirety (the atomic-commit contract: without
         ``.snapshot_metadata`` the tree is invisible to every reader).
 
-        Dry-run by default: returns the report without deleting. With
-        ``dry_run=False`` debris is deleted through the snapshot's own
-        storage plugin and empty directories are pruned (fs).
+        ``keep_roots`` — the **explicit keep-set** (bucket mode only):
+        committed child roots NOT named here (and not pinned in the
+        bucket's catalog — pins always survive) are **condemned** and
+        deleted whole, in a crash-convergent order: ``.snapshot_metadata``
+        first (the snapshot atomically stops being restorable), then the
+        data tree, then its catalog record LAST — so a crash mid-delete
+        leaves a record-marked *zombie* the next gc run finishes, and a
+        re-run always converges (chaos-tested). ``None`` keeps every
+        committed root (the classic debris sweep).
 
-        Single-rank, no collectives — but do NOT run it concurrently with a
-        take into the same tree: an in-flight take is indistinguishable
-        from a crashed one until it commits.
+        ``roots`` — extra candidate root names to consider beyond what the
+        bucket listing shows (``memory://`` children live in disjoint
+        namespaces the bucket cannot list; the retention engine passes the
+        catalog's record names so those backends collect too).
 
-        Returns ``{"committed": [prefixes], "uncommitted": [prefixes],
-        "keep": [paths], "remove": [paths], "removed": int,
-        "dry_run": bool}`` (paths relative to ``path``).
+        ``collect_debris=False`` restricts deletion to condemned roots,
+        zombies, and stale catalog records — uncommitted record-less trees
+        (possibly an IN-FLIGHT take) and loose files are left untouched,
+        which is what makes retention gc safe to run concurrently with
+        takes into the same bucket. The full sweep (default) keeps the
+        long-standing caveat: do NOT run it concurrently with a take, an
+        in-flight take is indistinguishable from a crashed one until it
+        commits.
+
+        The bucket's ``.catalog/`` tree is never treated as a snapshot
+        root: records of retained snapshots and pins are kept, records of
+        condemned/vanished snapshots are removed (after their trees).
+
+        Dry-run by default. Single-rank, no collectives. Returns
+        ``{"committed": [prefixes], "uncommitted": [prefixes],
+        "condemned": [prefixes], "keep": [paths], "remove": [paths],
+        "removed": int, "dry_run": bool}`` (paths relative to ``path``).
         """
+        from . import catalog as catalog_mod
         from .io_preparers.array import FRAME_TABLE_SUFFIX
 
         event_loop = asyncio.new_event_loop()
         storage = url_to_storage_plugin_in_event_loop(path, event_loop)
+        sub_plugins: Dict[str, StoragePlugin] = {}
+
+        def sub_plugin(root: str) -> StoragePlugin:
+            if root not in sub_plugins:
+                sub_plugins[root] = url_to_storage_plugin_in_event_loop(
+                    catalog_mod.join_bucket(path, root), event_loop
+                )
+            return sub_plugins[root]
+
         try:
             with telemetry.span("gc.scan", cat="gc", path=path):
-                all_paths = event_loop.run_until_complete(
-                    storage.list_prefix("")
+                all_paths = set(
+                    event_loop.run_until_complete(storage.list_prefix(""))
                 )
-                # Snapshot roots: ``path`` itself, or its immediate children.
-                if SNAPSHOT_METADATA_FNAME in all_paths:
-                    roots = [""]
-                else:
-                    roots = sorted(
-                        {p.partition("/")[0] for p in all_paths if "/" in p}
+                single = SNAPSHOT_METADATA_FNAME in all_paths
+                if single and keep_roots is not None:
+                    raise ValueError(
+                        "keep_roots applies to bucket-level gc; "
+                        f"{path} is itself a committed snapshot root"
                     )
-                committed: List[str] = []
-                uncommitted: List[str] = []
-                keep: Set[str] = set()
-                for root in roots:
+                cat_prefix = f"{catalog_mod.CATALOG_DIR}/"
+                # Catalog layer: record object -> snapshot name, pins, and
+                # catalog files we cannot classify (kept, fail-safe).
+                record_paths: Dict[str, List[str]] = {}
+                pinned: Set[str] = set()
+                catalog_keep: Set[str] = set()
+                import json as _json
+
+                if not single:
+                    for p in sorted(
+                        q for q in all_paths if q.startswith(cat_prefix)
+                    ):
+                        name = None
+                        try:
+                            read_io = ReadIO(path=p)
+                            storage.sync_read(read_io, event_loop)
+                            body = read_io.buf.getvalue().decode()
+                            name = str(_json.loads(body)["name"])
+                        except Exception:  # noqa: BLE001 - unclassifiable
+                            catalog_keep.add(p)
+                            continue
+                        if p.startswith(f"{catalog_mod.RECORD_DIR}/"):
+                            record_paths.setdefault(name, []).append(p)
+                        elif p.startswith(f"{catalog_mod.PIN_DIR}/"):
+                            pinned.add(name)
+                            catalog_keep.add(p)
+                        else:
+                            catalog_keep.add(p)
+
+                # Candidate snapshot roots: the bucket listing's children,
+                # every catalog-recorded name, and the caller's universe.
+                if single:
+                    root_names = [""]
+                else:
+                    root_names = sorted(
+                        (
+                            {
+                                p.partition("/")[0]
+                                for p in all_paths
+                                if "/" in p
+                            }
+                            - {catalog_mod.CATALOG_DIR}
+                        )
+                        | set(record_paths)
+                        | set(roots or [])
+                    )
+
+                # Per-root view: file paths (root-relative) and the plugin
+                # that owns them — the bucket plugin for listed children,
+                # the root's own sub-plugin for namespaces the bucket
+                # cannot list (memory://).
+                views: Dict[str, Dict[str, Any]] = {}
+                for root in root_names:
+                    prefix = f"{root}/" if root else ""
+                    if root:
+                        listed = sorted(
+                            p[len(prefix):]
+                            for p in all_paths
+                            if p.startswith(prefix)
+                        )
+                    else:
+                        listed = sorted(all_paths)
+                    sub: Optional[StoragePlugin] = None
+                    if root and not listed:
+                        try:
+                            sub = sub_plugin(root)
+                            listed = sorted(
+                                event_loop.run_until_complete(
+                                    sub.list_prefix("")
+                                )
+                            )
+                        except Exception:  # noqa: BLE001 - unlistable root
+                            listed = []
+                        if not listed:
+                            sub = None
+                    views[root] = {
+                        "paths": listed,
+                        "sub": sub,
+                        "committed": SNAPSHOT_METADATA_FNAME in listed,
+                    }
+
+                committed = sorted(
+                    r for r, v in views.items() if v["committed"]
+                )
+                uncommitted = sorted(
+                    r
+                    for r, v in views.items()
+                    if not v["committed"] and v["paths"]
+                )
+                keep_set = (
+                    set(keep_roots) | pinned
+                    if keep_roots is not None
+                    else None
+                )
+                # Condemnation universe: when the caller names its known
+                # roots (the retention engine passes the catalog's record
+                # names), only THOSE may be condemned — a committed
+                # snapshot the caller doesn't know about (unrecorded, or
+                # the whole catalog unreadable) is implicitly retained.
+                # Without this, a corrupted catalog would hand gc an empty
+                # keep-set and retention would delete every visible
+                # snapshot in the bucket.
+                universe = set(roots) if roots is not None else None
+                condemned = sorted(
+                    r
+                    for r in committed
+                    if keep_set is not None
+                    and r not in keep_set
+                    and (universe is None or r in universe)
+                )
+                # Zombies: a catalog record names the root but its tree is
+                # uncommitted — a crash interrupted a previous condemned
+                # delete after the metadata went. Finish the job (any
+                # mode; convergence demands it).
+                zombies = sorted(
+                    r
+                    for r in uncommitted
+                    if r in record_paths
+                )
+
+                retained = [r for r in committed if r not in condemned]
+                keep: Set[str] = set(catalog_keep)
+                observed: Set[str] = set(all_paths)
+                for root in views:
+                    prefix = f"{root}/" if root else ""
+                    if views[root]["sub"] is not None:
+                        observed.update(
+                            f"{prefix}{p}" for p in views[root]["paths"]
+                        )
+                for root in retained:
+                    v = views[root]
                     prefix = f"{root}/" if root else ""
                     meta_path = f"{prefix}{SNAPSHOT_METADATA_FNAME}"
-                    if meta_path not in all_paths:
-                        uncommitted.append(root)
-                        continue
-                    committed.append(root)
-                    read_io = ReadIO(path=meta_path)
-                    storage.sync_read(read_io, event_loop)
+                    if v["sub"] is not None:
+                        read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+                        v["sub"].sync_read(read_io, event_loop)
+                    else:
+                        read_io = ReadIO(path=meta_path)
+                        storage.sync_read(read_io, event_loop)
                     metadata = SnapshotMetadata.from_json(
                         read_io.buf.getvalue().decode("utf-8")
                     )
@@ -2246,20 +2581,83 @@ class Snapshot:
                     for r in range(metadata.world_size):
                         keep.add(f"{prefix}{CHECKSUM_FILE_PREFIX}{r}")
                     keep.update(
-                        p
-                        for p in all_paths
-                        if p.startswith(f"{prefix}.telemetry/")
+                        f"{prefix}{p}"
+                        for p in v["paths"]
+                        if p.startswith(".telemetry/")
                     )
-                remove = sorted(p for p in all_paths if p not in keep)
-            telemetry.counter_add("gc.files_scanned", len(all_paths))
-            telemetry.counter_add("gc.files_debris", len(remove))
+                    keep.update(record_paths.get(root, []))
+
+                # What goes, in three crash-ordered waves (bucket coords).
+                meta_wave: List[str] = []
+                tree_wave: List[str] = []
+                record_wave: List[str] = []
+                for root in condemned:
+                    prefix = f"{root}/" if root else ""
+                    meta_wave.append(f"{prefix}{SNAPSHOT_METADATA_FNAME}")
+                    tree_wave.extend(
+                        f"{prefix}{p}"
+                        for p in views[root]["paths"]
+                        if p != SNAPSHOT_METADATA_FNAME
+                    )
+                    record_wave.extend(record_paths.get(root, []))
+                for root in zombies:
+                    prefix = f"{root}/" if root else ""
+                    tree_wave.extend(
+                        f"{prefix}{p}" for p in views[root]["paths"]
+                    )
+                    record_wave.extend(record_paths.get(root, []))
+                # Stale records: the named tree is gone entirely (a prior
+                # gc crashed between tree and record deletion).
+                for name, paths in record_paths.items():
+                    if name in views and not views[name]["paths"]:
+                        record_wave.extend(paths)
+                if collect_debris:
+                    zombie_set = set(zombies)
+                    for root in uncommitted:
+                        if root in zombie_set:
+                            continue
+                        prefix = f"{root}/" if root else ""
+                        tree_wave.extend(
+                            f"{prefix}{p}" for p in views[root]["paths"]
+                        )
+                        record_wave.extend(record_paths.get(root, []))
+                    # Debris inside retained roots + loose bucket files.
+                    handled = {
+                        r
+                        for r in views
+                        if r in set(condemned) | zombie_set | set(uncommitted)
+                    }
+                    tree_wave.extend(
+                        sorted(
+                            p
+                            for p in observed
+                            if p not in keep
+                            and not p.startswith(cat_prefix)
+                            and p.partition("/")[0] not in handled
+                            and p
+                            not in set(meta_wave)
+                        )
+                    )
+                remove = sorted(set(meta_wave) | set(tree_wave))
+                remove_all = sorted(
+                    set(meta_wave) | set(tree_wave) | set(record_wave)
+                )
+            telemetry.counter_add("gc.files_scanned", len(observed))
+            telemetry.counter_add("gc.files_debris", len(remove_all))
             removed = 0
-            if not dry_run:
+            if not dry_run and remove_all:
                 with telemetry.span(
-                    "gc.delete", cat="gc", path=path, files=len(remove)
+                    "gc.delete", cat="gc", path=path, files=len(remove_all)
                 ):
 
-                    async def delete_all() -> int:
+                    def owner_of(p: str) -> Tuple[StoragePlugin, str]:
+                        root = p.partition("/")[0]
+                        v = views.get(root)
+                        if v is not None and v["sub"] is not None:
+                            return v["sub"], p[len(root) + 1:]
+                        return storage, p
+
+                    async def delete_wave(paths: List[str]) -> int:
                         sem = asyncio.Semaphore(
                             knobs.get_max_concurrent_io_for(storage)
                         )
@@ -2267,30 +2665,62 @@ class Snapshot:
 
                         async def delete_one(p: str) -> None:
                             nonlocal done
+                            plugin, rel = owner_of(p)
                             async with sem:
                                 try:
-                                    await storage.delete(p)
+                                    await plugin.delete(rel)
                                     done += 1
                                 except FileNotFoundError:
                                     done += 1  # already gone — goal reached
-                        await asyncio.gather(*(delete_one(p) for p in remove))
+                        await asyncio.gather(
+                            *(delete_one(p) for p in sorted(set(paths)))
+                        )
                         return done
 
-                    if remove:
-                        removed = event_loop.run_until_complete(delete_all())
+                    # Wave 1: condemned metadata — each snapshot atomically
+                    # stops being restorable before any data byte goes.
+                    removed += event_loop.run_until_complete(
+                        delete_wave(meta_wave)
+                    )
+                    # Wave 2: the trees (and, full sweep, loose debris).
+                    removed += event_loop.run_until_complete(
+                        delete_wave(tree_wave)
+                    )
+                    # Wave 3: catalog records LAST — a record only goes
+                    # once its tree is gone, so a crash anywhere above
+                    # leaves a zombie the next run recognizes and finishes.
+                    n_records = event_loop.run_until_complete(
+                        delete_wave(record_wave)
+                    )
+                    removed += n_records
+                    if n_records:
+                        telemetry.counter_add(
+                            "gc.records_removed", n_records
+                        )
                     # Even with no files to delete, a crashed take may have
                     # left empty directory skeletons (fs): prune them.
                     event_loop.run_until_complete(storage.prune_empty())
+                    for sub in sub_plugins.values():
+                        event_loop.run_until_complete(sub.prune_empty())
                 telemetry.counter_add("gc.files_removed", removed)
+            elif not dry_run:
+                with telemetry.span(
+                    "gc.delete", cat="gc", path=path, files=0
+                ):
+                    event_loop.run_until_complete(storage.prune_empty())
             return {
                 "committed": committed,
                 "uncommitted": uncommitted,
-                "keep": sorted(keep & set(all_paths)),
+                "condemned": condemned,
+                "keep": sorted(keep & observed),
                 "remove": remove,
+                "remove_records": sorted(set(record_wave)),
                 "removed": removed,
                 "dry_run": dry_run,
             }
         finally:
+            for sub in sub_plugins.values():
+                sub.sync_close(event_loop)
             storage.sync_close(event_loop)
             event_loop.close()
 
@@ -2890,11 +3320,16 @@ class PendingSnapshot:
         tm: Optional["telemetry.Telemetry"] = None,
         tm_prev: Optional["telemetry.Telemetry"] = None,
         phase_spans=None,
+        catalog_info: Optional[Tuple[str, Optional[int], Optional[str], int]] = None,
     ) -> None:
         self.path = path
         self._coord = coord
         self._metadata = metadata
         self._pending_io_work = pending_io_work
+        # (job, step, resolved base, chain_len) of a catalog-managed take;
+        # the background commit thread appends the record post-metadata,
+        # pre-barrier (rank 0) and refreshes the chain cache (every rank).
+        self._catalog_info = catalog_info
         # Telemetry session opened by async_take; closed (and the trace
         # written) when the background commit finishes, so drain spans land
         # in the same trace as the stall's planning phases.
@@ -2951,7 +3386,37 @@ class PendingSnapshot:
             barrier.arrive()
             if rank == 0:
                 Snapshot._write_snapshot_metadata(self._metadata, storage, event_loop)
+                if self._catalog_info is not None:
+                    # Same pre-barrier discipline as the sync path: the
+                    # record lands after metadata, before peers are
+                    # released. Fail-open; storage-only (no collectives
+                    # are legal on this thread, and none are used).
+                    job, step, base, chain_len = self._catalog_info
+                    Snapshot._append_catalog_record(
+                        self.path,
+                        storage,
+                        event_loop,
+                        world_size=self._metadata.world_size,
+                        job=job,
+                        step=step,
+                        base=base,
+                        chain_len=chain_len,
+                    )
             barrier.depart()
+            if self._catalog_info is not None:
+                from . import catalog as catalog_mod
+
+                try:
+                    split = catalog_mod.split_bucket(self.path)
+                    if split is not None and knobs.is_catalog_enabled():
+                        catalog_mod.note_commit(
+                            split[0],
+                            self._catalog_info[0],
+                            split[1],
+                            self._catalog_info[3],
+                        )
+                except Exception:  # noqa: BLE001 - cache refresh only
+                    pass
         except BaseException as e:  # noqa: BLE001 - re-raised in wait()
             logger.error(
                 "Async snapshot failed on rank %d:\n%s", rank, traceback.format_exc()

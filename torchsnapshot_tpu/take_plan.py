@@ -186,6 +186,11 @@ class PreflightResult:
     path: str
     base: Optional[str]
     replicated_globs: List[str]
+    # Recorded chain length of the base when it was CATALOG-auto-resolved
+    # during this preflight (>= 0; the take's own chain is base+1), or -1
+    # for an explicit/absent base. Broadcast with the decision so every
+    # rank records the same chain length.
+    base_chain_len: int = -1
 
 
 @dataclass
@@ -205,6 +210,8 @@ class TakePlan:
     # _take_impl keeps marking phases on the same tracker so the stall
     # decomposition covers planning + impl as one sequence.
     phase_tracker: Any = None
+    # See PreflightResult.base_chain_len.
+    base_chain_len: int = -1
 
 
 def get_plan_cache(coord: Coordinator) -> "Dict[str, CachedPlan]":
@@ -268,13 +275,18 @@ def preflight(
     """
     globs_local = sorted(set(replicated_globs))
     if coord.get_world_size() == 1:
+        base, base_chain = _resolve_base(base, path)
         return PreflightResult(
-            hit=False, path=path, base=base, replicated_globs=globs_local
+            hit=False,
+            path=path,
+            base=base,
+            replicated_globs=globs_local,
+            base_chain_len=base_chain,
         )
     gathered = coord.gather_object(
         (path, base, globs_local, plan_token, keys_sig), dst=0
     )
-    decision: Optional[Tuple[bool, str, Optional[str], List[str]]] = None
+    decision: Optional[Tuple[bool, str, Optional[str], List[str], int]] = None
     if gathered is not None:  # rank 0
         paths = [g[0] for g in gathered]
         bases = [g[1] for g in gathered]
@@ -315,19 +327,42 @@ def preflight(
                 "Ignoring rank-asymmetric replicated globs: %s", dropped
             )
         hit = tokens[0] is not None and all(t == tokens[0] for t in tokens)
-        decision = (hit, paths[0], bases[0], sorted(common))
+        # Catalog auto-base resolution happens HERE, on rank 0 only: one
+        # catalog reader per take (steady-state hits the per-process chain
+        # cache and does no storage I/O), and the RESOLVED base + its
+        # recorded chain length ride the decision broadcast below — every
+        # rank agrees on the base by construction, with no per-rank
+        # catalog reads to race against a concurrent commit.
+        base0, base_chain = _resolve_base(bases[0], paths[0])
+        decision = (hit, paths[0], base0, sorted(common), base_chain)
     # Broadcast OUTSIDE the rank-0 block above: the decision collective
     # must be issued by every rank (src posts, sinks read) — keeping it
     # under the `gathered is not None` branch would be exactly the TSA901
     # rank-conditional-collective hazard the analyzer now gates.
     decision = coord.broadcast_object(decision, src=0)
-    hit, canonical_path, canonical_base, common_globs = decision
+    hit, canonical_path, canonical_base, common_globs, base_chain = decision
     return PreflightResult(
         hit=hit,
         path=canonical_path,
         base=canonical_base,
         replicated_globs=common_globs,
+        base_chain_len=base_chain,
     )
+
+
+def _resolve_base(
+    base: Optional[str], path: str
+) -> Tuple[Optional[str], int]:
+    """Resolve a catalog auto-base sentinel (``Snapshot.take(job=...)``)
+    into a real base path + its recorded chain length; explicit/absent
+    bases pass through with chain -1 (unknown). Local storage I/O only —
+    no collectives (the caller broadcasts the result)."""
+    from . import catalog as catalog_mod
+
+    if base is None or not catalog_mod.is_auto_base(base):
+        return base, -1
+    resolved, chain = catalog_mod.resolve_auto_base(base, path)
+    return resolved, (chain if resolved is not None else 0)
 
 
 def gather_manifest_delta(

@@ -1067,6 +1067,47 @@ def override_read_merge_gap_bytes(value: int):
     return _override_env(_ENV_READ_MERGE_GAP, str(value))
 
 
+_ENV_CATALOG = "TORCHSNAPSHOT_TPU_CATALOG"
+_ENV_MAX_CHAIN_LEN = "TORCHSNAPSHOT_TPU_MAX_CHAIN_LEN"
+
+_DEFAULT_MAX_CHAIN_LEN = 16
+
+
+def is_catalog_enabled() -> bool:
+    """The per-bucket snapshot catalog (``catalog.py``): takes that pass
+    ``job=`` append an atomically-written record (job, step, base pointer,
+    chain length, byte attribution) under ``<bucket>/.catalog/`` at commit
+    time, auto-select their ``base=`` from the latest committed same-job
+    record, and retention policies (``catalog retain`` / ``gc --policy``)
+    drive chain-aware garbage collection off those records. ``0`` disables
+    both the commit-time append and auto-base selection (takes with
+    ``job=`` then behave like plain full takes); existing records are
+    never consulted. Default on — the catalog is fail-open by contract
+    (an append failure can never fail or delay a commit)."""
+    return os.environ.get(_ENV_CATALOG, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def get_max_chain_len() -> int:
+    """Default rebase-to-full policy for catalog-managed delta chains
+    (``Snapshot.take(job=...)`` without an explicit ``max_chain_len=``): an
+    auto-selected base whose recorded chain is already this many deltas
+    deep is refused and the take rebases to a FULL snapshot (chain length
+    0). Bounds both the blast radius of a single rotten delta and the
+    sidecar/metadata walk a retention scan pays per chain (default 16,
+    floor 1)."""
+    return max(1, _get_int(_ENV_MAX_CHAIN_LEN, _DEFAULT_MAX_CHAIN_LEN))
+
+
+def override_catalog(enabled: bool):
+    return _override_env(_ENV_CATALOG, "1" if enabled else "0")
+
+
+def override_max_chain_len(value: int):
+    return _override_env(_ENV_MAX_CHAIN_LEN, str(value))
+
+
 _ENV_FAULTS = "TORCHSNAPSHOT_TPU_FAULTS"
 
 
