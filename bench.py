@@ -318,13 +318,38 @@ def _regression_gate_impl(
         "priors": len(priors),
         "link_comparable_priors": len(link_comparable),
         "best_prior_drain_s": round(best_drain_s, 2),
-        "best_prior_drain_vs_link": round(best_vs_link, 2),
-        "best_prior_restore_s": round(best_restore_s, 2),
-        "best_prior_stage_hash_s": round(best_hash_s, 2),
-        "best_prior_reshard_wall_s": round(best_reshard_wall, 2),
-        "best_prior_reshard_ratio": round(best_ratio, 3),
         "problems": problems,
     }
+    # Metrics with NO prior are reported as ABSENT, not as a 0.0 floor: a
+    # zero "best prior" can never flag a regression, so emitting it reads
+    # as a fake "ok" (the r07 lesson — best_prior_reshard_wall_s: 0.0 /
+    # best_prior_drain_vs_link: 0.0 looked like passing gates that were
+    # actually empty). Each absent metric is named in fresh_metrics so the
+    # trajectory records WHICH comparisons seeded fresh this round.
+    fresh = []
+    for key, has_prior, value, digits in (
+        ("best_prior_drain_vs_link", bool(link_comparable), best_vs_link, 2),
+        ("best_prior_restore_s", bool(restore_priors), best_restore_s, 2),
+        ("best_prior_stage_hash_s", bool(hash_priors), best_hash_s, 2),
+        (
+            "best_prior_reshard_wall_s",
+            bool(reshard_wall_priors),
+            best_reshard_wall,
+            2,
+        ),
+        ("best_prior_reshard_ratio", bool(ratio_priors), best_ratio, 3),
+    ):
+        if has_prior:
+            out[key] = round(value, digits)
+        else:
+            fresh.append(key)
+    if fresh:
+        out["fresh_metrics"] = fresh
+        log(
+            "WARNING: bench regression gate: no prior round constrains "
+            f"{', '.join(fresh)} — these gates seed fresh this round "
+            "(reported absent, not 0.0)"
+        )
     if link_note:
         out["link_note"] = link_note
     return out
@@ -673,6 +698,25 @@ def main() -> None:
             "all": stream_sides,
         }
         log(f"stream A/B medians: on={stream_ab['on']} off={stream_ab['off']}")
+        # Fail-soft inversion flag: streaming exists to BEAT the whole-
+        # buffer path; when ON underperforms OFF by >10% on this host (the
+        # r07 artifact measured 0.21 vs 0.36 GB/s and buried it in
+        # `detail`), say so loudly and mark the artifact so the trajectory
+        # records the inversion as a first-class signal instead of a
+        # footnote.
+        ab_on, ab_off = stream_ab["on"]["drain_gbps"], stream_ab["off"]["drain_gbps"]
+        stream_ab["stream_ab_inverted"] = bool(
+            ab_off > 0 and ab_on < 0.9 * ab_off
+        )
+        if stream_ab["stream_ab_inverted"]:
+            log(
+                "WARNING: stream A/B INVERTED on this host: streaming ON "
+                f"drained at {ab_on:.3f} GB/s vs OFF at {ab_off:.3f} GB/s "
+                "(>10% slower) — chunk streaming is hurting, not helping; "
+                "suspect chunk size vs this host's per-append overhead "
+                "(TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES) before trusting "
+                "the streamed path's defaults here"
+            )
 
         # ---- persisted-telemetry summary: the async checkpoint carries its
         # own attribution (.telemetry/rank_0.json written by the drain);

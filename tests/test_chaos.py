@@ -1733,3 +1733,123 @@ def test_chaos_matrix_retention_transient_storms(any_backend) -> None:
         "backoff=0.005;seed=7;op=delete,p=0.5,kind=transient,times=6",
         expect_raise=False,
     )
+
+
+# ---------------------------------------------------------------------------
+# Engine QoS preemption under chaos: a BACKGROUND drain and a FOREGROUND
+# restore share one process (the serving-fleet scenario the engine's
+# priority classes exist for) while kill/fault schedules hit one side. Both
+# operations must land in the structured-abort-or-bit-exact contract with a
+# balanced budget ledger — the harness's autouse fixtures keep BOTH runtime
+# sanitizers (TORCHSNAPSHOT_TPU_DEBUG_LEDGER + _DEBUG_COLLECTIVES) on.
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_foreground_restore_rides_through_drain_write_fault(
+    tmp_path,
+) -> None:
+    """A permanent write fault kills the BACKGROUND drain while a
+    FOREGROUND restore runs beside it: the drain aborts structured (no
+    metadata, budget fully credited), the restore completes bit-exact, and
+    the committed foreground snapshot stays clean — a dying background op
+    can neither corrupt nor wedge the foreground one."""
+    fg = str(tmp_path / "fg")
+    Snapshot.take(fg, _state(seed=3))
+    with knobs.override_qos_poll_s(0.005):
+        with knobs.override_faults("op=write,kind=fail,path=0/s"):
+            pending = Snapshot.async_take(
+                str(tmp_path / "bg"), _state(seed=4), qos="background"
+            )
+            # Foreground restore while the faulted drain runs (its writes
+            # fail; the restore's reads are untouched by the spec).
+            _assert_restores_bit_exact(fg, seed=3)
+            with pytest.raises(CheckpointAbortedError) as exc_info:
+                pending.wait()
+    assert exc_info.value.phase == "write"
+    assert pending._pending_io_work.budget_balanced
+    assert not os.path.exists(
+        os.path.join(str(tmp_path / "bg"), ".snapshot_metadata")
+    )
+    assert Snapshot(fg).verify() == {}
+
+
+def test_chaos_foreground_transient_storm_under_background_drain(
+    tmp_path,
+) -> None:
+    """The mirror leg: a transient read storm hits the FOREGROUND restore
+    while a clean BACKGROUND drain runs. The restore self-heals through the
+    collective-progress retry discipline (bit-exact), and the drain commits
+    and verifies clean — preemption pauses are pauses, never aborts."""
+    fg = str(tmp_path / "fg")
+    Snapshot.take(fg, _state(seed=5))
+    with knobs.override_qos_poll_s(0.005):
+        pending = Snapshot.async_take(
+            str(tmp_path / "bg"), _state(seed=6), qos="background"
+        )
+        # The drain's plugin was constructed BEFORE the override, so the
+        # injected read faults hit only the restore's fresh plugin.
+        with knobs.override_faults(
+            "backoff=0.005;op=read,kind=transient,times=3"
+        ):
+            _assert_restores_bit_exact(fg, seed=5)
+        pending.wait()
+    assert pending._pending_io_work.budget_balanced
+    assert Snapshot(str(tmp_path / "bg")).verify() == {}
+    _assert_restores_bit_exact(str(tmp_path / "bg"), seed=6)
+
+
+def test_chaos_kill_mid_background_drain_with_foreground_restore(
+    tmp_path,
+) -> None:
+    """Real process death mid-drain while the same process serves a
+    foreground restore: the child dies at the injected kill point (the drain's first data write), the
+    torn background take exposes no metadata, and the committed foreground
+    snapshot survives — verifies clean and restores bit-exact in the
+    parent."""
+    parent = str(tmp_path)
+    fg = os.path.join(parent, "fg")
+    Snapshot.take(fg, _state(seed=1))
+    _assert_restores_bit_exact(fg, seed=1)
+
+    code = (
+        "import os, numpy as np\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from torchsnapshot_tpu import Snapshot, StateDict\n"
+        "rng = np.random.default_rng(2)\n"
+        "state = {'s': StateDict(\n"
+        "    w=rng.standard_normal(512).astype(np.float32),\n"
+        "    b=np.arange(64, dtype=np.int64) + 2, step=2)}\n"
+        "pending = Snapshot.async_take(\n"
+        "    os.environ['CHAOS_BG'], state, qos='background')\n"
+        "tgt = {'s': StateDict(w=np.zeros(512, np.float32),\n"
+        "                      b=np.zeros(64, np.int64), step=-1)}\n"
+        "Snapshot(os.environ['CHAOS_FG']).restore(tgt, qos='foreground')\n"
+        "pending.wait()\n"
+    )
+    env = dict(
+        os.environ,
+        CHAOS_BG=os.path.join(parent, "bg"),
+        CHAOS_FG=fg,
+        TORCHSNAPSHOT_TPU_FAULTS="op=write,kind=kill,path=0/s",
+        TORCHSNAPSHOT_TPU_DEBUG_LEDGER="1",
+        TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES="1",
+        TORCHSNAPSHOT_TPU_QOS_POLL_S="0.005",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True
+    )
+    assert proc.returncode == KILL_EXIT_CODE, (
+        proc.returncode,
+        proc.stderr[-1500:],
+    )
+    # The torn background take is invisible; the foreground snapshot is
+    # intact.
+    assert not os.path.exists(
+        os.path.join(parent, "bg", ".snapshot_metadata")
+    )
+    assert Snapshot(fg).verify() == {}
+    _assert_restores_bit_exact(fg, seed=1)
+    # gc reclaims the kill's debris and a retake into the parent succeeds.
+    Snapshot.gc(parent, dry_run=False)
+    Snapshot.take(os.path.join(parent, "bg2"), _state(seed=7))
+    _assert_restores_bit_exact(os.path.join(parent, "bg2"), seed=7)

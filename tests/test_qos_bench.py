@@ -1,0 +1,70 @@
+"""QoS benchmark harness: fast tier-1 smoke + the slow acceptance lane.
+
+The smoke proves the preemption machinery end to end at tiny scale under
+the shared-bandwidth disk model (the BACKGROUND drain yields admissions to
+FOREGROUND reads — preemption counters nonzero on the QoS side, zero on
+the FIFO side — and both operations complete with balanced budgets). The
+slow-marked run — registered in pre_commit.yaml's slow lane — is the
+acceptance-scale leg asserting the headline: foreground-restore p99 under
+a concurrent background drain IMPROVES vs priority-off (FIFO)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_bench(extra_env: dict = None, timeout: int = 420) -> dict:
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/qos/main.py"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_qos_bench_smoke() -> None:
+    result = _run_bench(
+        {
+            "QOS_BENCH_BG_MB": "16",
+            "QOS_BENCH_FG_MB": "4",
+            "QOS_BENCH_RESTORES": "2",
+            "QOS_BENCH_REPS": "1",
+            "QOS_BENCH_OBJ_MB": "1",
+            "QOS_BENCH_DISK_MBPS": "300",
+        }
+    )
+    assert result["metric"] == "qos_fg_restore_p99_speedup_vs_fifo"
+    det = result["detail"]
+    # Mechanics (the harness hard-asserts these too): the QoS-on drain
+    # actually yielded, the FIFO side never did, and the e2e public-API leg
+    # completed bit-exact.
+    assert det["drain_preemptions_on"] > 0
+    assert det["e2e"]["restore_walls_s"]
+    assert result["value"] > 0
+
+
+@pytest.mark.slow
+def test_qos_bench_foreground_p99_beats_fifo() -> None:
+    """Acceptance scale: under the deterministic shared-disk model, the
+    priority-aware engine must deliver better foreground-restore p99 than
+    FIFO — the engine tentpole's measurable claim."""
+    result = _run_bench(timeout=600)
+    det = result["detail"]
+    assert det["drain_preemptions_on"] > 0
+    assert result["value"] > 1.05, result
+    # The drain pays a bounded cost, not a collapse: its wall under QoS
+    # stays within 3x of FIFO's at this schedule (it paused for exactly
+    # the foreground reads' duration).
+    assert det["drain_wall_s"]["on"] < det["drain_wall_s"]["off"] * 3.0

@@ -109,15 +109,32 @@ def test_span_records_error_attr() -> None:
 
 
 def test_activation_is_guarded_against_late_deactivate() -> None:
-    """A late-finishing background session must not clobber a newer one."""
+    """A late-finishing background session must not clobber a newer one —
+    and, once closed, must never be resurrected when the newer one closes.
+    Concurrent QoS-classed operations (a BACKGROUND drain beside a
+    FOREGROUND restore) close their sessions out of LIFO order; restoring
+    a closed session would leak it as permanently active (nothing will
+    ever deactivate it again) and silently swallow every later op's
+    spans."""
     old, new = Telemetry(), Telemetry()
     prev_old = telemetry.activate(old)
     prev_new = telemetry.activate(new)  # newer session takes over
     telemetry.deactivate(old, prev_old)  # late deactivate of the OLD one
-    assert telemetry.get_active() is new
+    assert telemetry.get_active() is new  # guarded: no clobber
     telemetry.deactivate(new, prev_new)
-    assert telemetry.get_active() is old
-    telemetry.deactivate(old, None)
+    # The already-closed old session is walked past, not resurrected.
+    assert telemetry.get_active() is None
+
+
+def test_lifo_deactivate_still_restores_open_previous() -> None:
+    """The nested (LIFO) shape keeps its semantics: closing the inner
+    session restores the still-open outer one."""
+    outer, inner = Telemetry(), Telemetry()
+    prev_outer = telemetry.activate(outer)
+    prev_inner = telemetry.activate(inner)
+    telemetry.deactivate(inner, prev_inner)
+    assert telemetry.get_active() is outer
+    telemetry.deactivate(outer, prev_outer)
     assert telemetry.get_active() is None
 
 

@@ -74,6 +74,7 @@ from .manifest import (
     ShardedArrayEntry,
     is_replicated,
 )
+from .engine import qos as engine_qos
 from .scheduler import (
     ReadVerificationError,
     _read_digest_record,
@@ -321,6 +322,8 @@ class _BcastSession:
         pipeline's reads."""
         loop = asyncio.get_running_loop()
         path, byte_range = key
+        # Chunk-granular QoS yield before the origin read (see engine/qos).
+        await engine_qos.pause_point()
 
         async def fetch_once() -> bytes:
             read_io = ReadIO(path=path, byte_range=byte_range)

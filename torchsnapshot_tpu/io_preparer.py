@@ -23,7 +23,8 @@ chunks for transfer/I-O pipelining.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Set, Tuple
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -365,8 +366,21 @@ def prepare_write(
     world_size: int,
     replicated_paths: Set[str],
     is_async_snapshot: bool = False,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[Manifest, List[WriteReq]]:
-    """Plan all writes for this rank's flattened state (no data moves yet)."""
+    """Plan all writes for this rank's flattened state (no data moves yet).
+
+    ``timings``: optional out-param decomposing this call's wall time into
+    the ``stage.prepare.*`` buckets — ``d2h_hint`` (the defensive device
+    fork + transfer hints), ``stager_construction`` (the per-preparer
+    ``prepare_write`` calls building stagers/manifest entries), and
+    ``plan`` (classification, path mapping, everything else). The take
+    path persists them as sub-spans of the ``prepare_write`` stall phase,
+    so the stall decomposition's dominant phase is attributable instead of
+    a single opaque number."""
+    t_begin = time.monotonic()
+    d2h_hint_s = 0.0
+    stager_s = 0.0
     manifest: Manifest = {}
     write_reqs: List[WriteReq] = []
     if is_async_snapshot:
@@ -377,7 +391,9 @@ def prepare_write(
         # (``scheduler.py:178-214``).
         device_paths = [p for p, v in flattened.items() if _is_jax_array(v)]
         if device_paths and knobs.is_async_device_copy_enabled():
+            t0 = time.monotonic()
             copies = _defensive_device_copies([flattened[p] for p in device_paths])
+            d2h_hint_s += time.monotonic() - t0
             flattened = dict(flattened)
             flattened.update(zip(device_paths, copies))
     device_paths_set = {p for p, v in flattened.items() if _is_plannable_array(v)}
@@ -398,11 +414,13 @@ def prepare_write(
             continue
 
         if kind == "sharded":
+            t0 = time.monotonic()
             entry, reqs = ShardedArrayIOPreparer.prepare_write(
                 logical_path,
                 value,
                 is_async_snapshot=is_async_snapshot and not is_captured,
             )
+            stager_s += time.monotonic() - t0
             manifest[logical_path] = entry
             if is_async_snapshot:
                 for r in reqs:
@@ -423,6 +441,7 @@ def prepare_write(
                 # Fully-replicated multi-device array: stage from the local copy.
                 arr = arr.addressable_shards[0].data
             storage_path = get_storage_path(logical_path, rank, replicated)
+            t0 = time.monotonic()
             if should_chunk(arr):
                 entry, reqs = ChunkedArrayIOPreparer.prepare_write(
                     storage_path, arr, replicated, is_async_snapshot and not is_captured
@@ -431,6 +450,7 @@ def prepare_write(
                 entry, reqs = ArrayIOPreparer.prepare_write(
                     storage_path, arr, replicated, is_async_snapshot and not is_captured
                 )
+            stager_s += time.monotonic() - t0
             manifest[logical_path] = entry
             if is_async_snapshot and is_device_value:
                 for r in reqs:
@@ -440,9 +460,17 @@ def prepare_write(
 
         # object fallback
         storage_path = get_storage_path(logical_path, rank, glob_replicated)
+        t0 = time.monotonic()
         entry, reqs = ObjectIOPreparer.prepare_write(
             storage_path, value, replicated=glob_replicated
         )
+        stager_s += time.monotonic() - t0
         manifest[logical_path] = entry
         write_reqs.extend(reqs)
+    if timings is not None:
+        total = time.monotonic() - t_begin
+        timings["d2h_hint"] = d2h_hint_s
+        timings["stager_construction"] = stager_s
+        # Classification, path mapping, manifest assembly — the remainder.
+        timings["plan"] = max(0.0, total - d2h_hint_s - stager_s)
     return manifest, write_reqs

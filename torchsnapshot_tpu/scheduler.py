@@ -1,36 +1,53 @@
-"""Memory-budgeted async execution pipelines.
+"""Memory-budgeted async execution pipelines, lowered onto the dataflow
+engine.
 
 Conceptual port of the reference's scheduler state machine
 (``/root/reference/torchsnapshot/scheduler.py:220-461``) — not of its code.
+Since the engine unification, this module is the *graph builder* layer:
+``execute_write_reqs`` / ``execute_read_reqs`` translate write/read request
+lists into task graphs (see ``engine/graph.py``) and the shared
+:class:`~.engine.GraphExecutor` owns the machinery that used to live here
+three times over — budget admission, slot caps, task tables, abort sweeps,
+interval/span recording, occupancy reporting, the stall watchdog, and QoS
+preemption. What remains here is the checkpoint domain logic: what staging
+means, hashing/dedup, sidecar commit, and read verification.
 
-Write pipeline stages::
+Write pipeline graph (one chain per request)::
 
-    ready_for_staging ──(budget admits)──> staging ──> ready_for_io ──> io ──> done
-                         D2H + serialize                 storage.write
-                         (thread pool,                   (async, in-flight cap:
-                          TORCHSNAPSHOT_TPU_              TORCHSNAPSHOT_TPU_
-                          STAGING_THREADS)                MAX_CONCURRENT_IO)
+    stage ──(budget+data edge)──> io            whole-buffer requests
+    D2H + serialize               hash + dedup + storage.write
+    (pool: staging)               (pool: io, cap MAX_CONCURRENT_IO)
 
-The memory budget is debited by each request's estimated staging cost when it
-is admitted, corrected to the actual buffer size when staging completes, and
-credited back when its storage write completes. One over-budget request is
-always admitted when the pipeline is otherwise empty, so a single huge array
-can't deadlock the pipeline (reference ``scheduler.py:268``).
+    stream                                      chunk-streamed requests
+    (pool: streaming, cap MAX_CONCURRENT_IO; per-chunk budget inside)
+
+The memory budget is debited by each request's estimated staging cost when
+it is admitted, corrected to the actual buffer size when staging completes,
+and credited back when its storage write completes — the reservation rides
+the graph edge. One over-budget request is always admitted when the graph
+is otherwise empty, so a single huge array can't deadlock the pipeline
+(reference ``scheduler.py:268``).
 
 ``execute_write_reqs`` returns at the **capture point**: every request whose
 source training could still invalidate (mutable host arrays, objects) has
 been staged into private host buffers under the memory budget — the
 reference's capture semantics (``scheduler.py:178-214``). Requests flagged
 ``defer_staging`` (device arrays: immutable, and defensively forked against
-donation by ``io_preparer._defensive_device_copies``) skip that wait; the
-returned :class:`PendingIOWork` drains their device→host transfer plus all
-storage I/O in the background, still under the same budget. For
-device-dominated snapshots — the TPU norm — ``async_take``'s stall is thus
-planning time only, independent of checkpoint size.
+donation by ``io_preparer._defensive_device_copies``) enter the graph as
+*deferred* nodes; the returned :class:`PendingIOWork` releases them and
+drains device→host transfer plus all storage I/O in the background, still
+under the same budget. For device-dominated snapshots — the TPU norm —
+``async_take``'s stall is thus planning time only, independent of
+checkpoint size.
 
-The read pipeline mirrors it: storage reads are admitted under a consuming
-budget and buffers are handed to consumers (deserialize + scatter) on the
-thread pool.
+The read pipeline is the mirrored graph: ``read_io`` (fetch + digest
+verify) → ``consume`` (deserialize + scatter) chains admitted under a
+consuming budget.
+
+Every pipeline carries a QoS class (``engine.Priority``, inherited from the
+ambient :func:`~.engine.qos.priority_scope` or passed explicitly): a
+FOREGROUND restore preempts a BACKGROUND drain's next admission at chunk
+granularity through the process-wide arbiter.
 """
 
 from __future__ import annotations
@@ -42,13 +59,21 @@ import logging
 import os
 import socket
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import d2h, hashing, ledger, telemetry
+from . import d2h, hashing, telemetry
+from .engine import GraphExecutor, Node, Priority
+from .engine.executor import Budget as _Budget  # noqa: F401 - test surface
+from .engine.executor import ProgressReporter as _ProgressReporter  # noqa: F401
+from .engine.intervals import (
+    clip_merged as _clip_merged,
+    measure as _measure,
+    merge_intervals as _merge_intervals,
+    stream_stats as _stream_stats,
+)
 from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
 from .storage_plugins.cloud_retry import (
     CollectiveProgress,
@@ -58,6 +83,8 @@ from .storage_plugins.cloud_retry import (
 from .utils import knobs
 
 logger = logging.getLogger(__name__)
+
+_STAGE_POOLS = ("staging", "streaming")
 
 
 class ReadVerificationError(RuntimeError):
@@ -69,82 +96,6 @@ class ReadVerificationError(RuntimeError):
     ``TORCHSNAPSHOT_TPU_VERIFY_READS=all`` (cache hits carry their own
     default-on verification inside the cache plugin)."""
 
-
-# ---------------------------------------------------------------------------
-# Interval algebra for the stream-overlap stats. The pipelines record one
-# (t0, t1) interval per staging/io task — the same data telemetry exports as
-# scheduler stage/io spans — and the drain/pipeline stats are DERIVED from
-# those intervals by union/intersection, so the trace and the stats can
-# never disagree about where the time went.
-# ---------------------------------------------------------------------------
-
-def _merge_intervals(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
-    """Sorted union of possibly-overlapping intervals."""
-    out: List[Tuple[float, float]] = []
-    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
-        if out and t0 <= out[-1][1]:
-            if t1 > out[-1][1]:
-                out[-1] = (out[-1][0], t1)
-        else:
-            out.append((t0, t1))
-    return out
-
-
-def _clip_merged(
-    merged: List[Tuple[float, float]], w0: float, w1: float
-) -> List[Tuple[float, float]]:
-    return [
-        (max(t0, w0), min(t1, w1)) for t0, t1 in merged if t1 > w0 and t0 < w1
-    ]
-
-
-def _measure(merged: List[Tuple[float, float]]) -> float:
-    return sum(t1 - t0 for t0, t1 in merged)
-
-
-def _intersect_merged(
-    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
-) -> List[Tuple[float, float]]:
-    out: List[Tuple[float, float]] = []
-    i = j = 0
-    while i < len(a) and j < len(b):
-        t0 = max(a[i][0], b[j][0])
-        t1 = min(a[i][1], b[j][1])
-        if t1 > t0:
-            out.append((t0, t1))
-        if a[i][1] < b[j][1]:
-            i += 1
-        else:
-            j += 1
-    return out
-
-
-def _stream_stats(
-    windows: List[Tuple[float, float]],
-    stage_intervals: List[Tuple[float, float]],
-    io_intervals: List[Tuple[float, float]],
-) -> Dict[str, float]:
-    """wall/stage_busy/io_busy/overlap/idle over the given accounting
-    windows. Only activity inside a window is attributed (matching the old
-    wait-loop accounting: the gap between an async take's capture point and
-    its background drain is nobody's time)."""
-    stage = _merge_intervals(stage_intervals)
-    io = _merge_intervals(io_intervals)
-    both = _intersect_merged(stage, io)
-    wall = stage_busy = io_busy = overlap = 0.0
-    for w0, w1 in windows:
-        wall += w1 - w0
-        stage_busy += _measure(_clip_merged(stage, w0, w1))
-        io_busy += _measure(_clip_merged(io, w0, w1))
-        overlap += _measure(_clip_merged(both, w0, w1))
-    union = stage_busy + io_busy - overlap
-    return {
-        "wall_s": wall,
-        "stage_busy_s": stage_busy,  # D2H + serialize stream in flight
-        "io_busy_s": io_busy,  # storage-write stream in flight
-        "overlap_s": overlap,  # both streams concurrently in flight
-        "idle_s": max(0.0, wall - union),  # neither stream active
-    }
 
 CHECKSUM_FILE_PREFIX = ".checksums."  # one JSON sidecar per rank
 
@@ -268,86 +219,13 @@ class PipelinePools:
         self._staging = self._hash = self._consuming = self._lanes = None
 
 
-class _Budget:
-    def __init__(self, total: int, owner: str = "pipeline") -> None:
-        self.total = total
-        self.available = total
-        # Lowest availability seen — the budget high-water mark
-        # (total - min_available) is a telemetry gauge at pipeline end.
-        self.min_available = total
-        # Debug-mode sanitizer (TORCHSNAPSHOT_TPU_DEBUG_LEDGER): journals
-        # every debit with its owner/call-site so assert_balanced can name
-        # leaking sites. None in production — the hot path stays two adds.
-        self.ledger = ledger.maybe_ledger(owner)
-
-    def debit(self, n: int) -> None:
-        self.available -= n
-        if self.available < self.min_available:
-            self.min_available = self.available
-        if self.ledger is not None:
-            self.ledger.record_debit(n)
-
-    def credit(self, n: int) -> None:
-        self.available += n
-        if self.ledger is not None:
-            self.ledger.record_credit(n)
-
-    def assert_balanced(self, context: str) -> None:
-        """Ledger-mode assertion that every debit has been credited back —
-        called at pipeline close and on every abort path. No-op (and no
-        allocation) unless the debug-ledger knob is set."""
-        if self.ledger is not None:
-            self.ledger.assert_balanced(context)
-
-    @property
-    def high_water_bytes(self) -> int:
-        return self.total - self.min_available
-
-
-class _ProgressReporter:
-    """Periodic per-rank pipeline-occupancy logging (reference
-    ``scheduler.py:96-175``): how many requests sit in each stage, bytes
-    moved, budget headroom, and RSS delta since the pipeline began. Logged
-    at most once per ``interval_s``, from the event-loop side of the
-    pipeline (so a stall in staging/I-O shows its last known occupancy)."""
-
-    def __init__(self, rank: int, kind: str, interval_s: float = 10.0) -> None:
-        self.rank = rank
-        self.kind = kind
-        self.interval_s = interval_s
-        self._last_ts = time.monotonic()
-        try:
-            self._rss0 = psutil.Process(os.getpid()).memory_info().rss
-        except Exception:  # pragma: no cover - psutil hiccup
-            self._rss0 = 0
-
-    def maybe_report(self, stages: Dict[str, int], bytes_done: int, budget: _Budget) -> None:
-        now = time.monotonic()
-        if now - self._last_ts < self.interval_s:
-            return
-        self._last_ts = now
-        try:
-            rss_delta = psutil.Process(os.getpid()).memory_info().rss - self._rss0
-        except Exception:  # pragma: no cover
-            rss_delta = 0
-        occupancy = " ".join(f"{k}={v}" for k, v in stages.items())
-        logger.info(
-            "Rank %d %s pipeline: %s | %.2f GB done | budget %.2f/%.2f GB | "
-            "RSS delta %+.2f GB",
-            self.rank,
-            self.kind,
-            occupancy,
-            bytes_done / 1e9,
-            budget.available / 1e9,
-            budget.total / 1e9,
-            rss_delta / 1e9,
-        )
-
-
 class _WritePipeline:
-    """The write-side state machine; resumable so deferred staging
-    (``WriteReq.defer_staging``) can finish on the async-commit background
-    thread."""
+    """The write-side graph builder + domain node bodies. Builds one engine
+    chain per request (``stage → io``, or one self-budgeted ``stream``
+    node) and keeps the checkpoint semantics — hashing, dedup link-in,
+    sidecar commit, capture point — while the engine owns execution.
+    Resumable so deferred staging (``WriteReq.defer_staging``) can finish
+    on the async-commit background thread."""
 
     def __init__(
         self,
@@ -359,6 +237,7 @@ class _WritePipeline:
             Callable[[], Optional[Tuple[str, Dict[str, list]]]]
         ] = None,
         pools: Optional[PipelinePools] = None,
+        priority: Optional[Priority] = None,
     ) -> None:
         self.storage = storage
         # Thread pools: shared with the operation's other pipelines when the
@@ -379,6 +258,11 @@ class _WritePipeline:
         # The chunked-hashing grain, resolved once for the same reason
         # (0 = the serial v1 fold; objects <= one chunk keep v1 records).
         self._hash_grain = knobs.get_hash_chunk_bytes()
+        # Stream knobs are resolved at graph build (first run), matching
+        # the legacy dispatch-time reads — callers override them around the
+        # pipeline RUN, not necessarily its construction.
+        self._stream_chunk = 0
+        self._stream_inflight = 1
         # Set at base resolution: True when the base's sidecars carry v1
         # whole-object identities, so new objects must compute the whole
         # sha256 too (the compat shim) or dedup would spuriously re-upload.
@@ -388,7 +272,6 @@ class _WritePipeline:
         self.bytes_deduped = 0
         self.rank = rank
         self.begin_ts = time.monotonic()
-        self.budget = _Budget(memory_budget_bytes, owner=f"write@rank{rank}")
         # Live progress counters (PendingSnapshot.progress()): totals start
         # as staging-cost estimates and converge on actual bytes as staging
         # completes, so bytes_written ends equal to the payload total.
@@ -399,104 +282,113 @@ class _WritePipeline:
                 r.buffer_stager.get_staging_cost_bytes() for r in write_reqs
             ),
         )
-        # Stage big requests first: they dominate the critical path and admit
-        # small ones into the leftover budget.
-        by_size = sorted(
-            write_reqs, key=lambda r: -r.buffer_stager.get_staging_cost_bytes()
-        )
-        self.pending: Deque[WriteReq] = deque(
-            r for r in by_size if not r.defer_staging
-        )
-        # Staged only after run_until_staged's capture point (see
-        # WriteReq.defer_staging).
-        self.deferred: List[WriteReq] = [r for r in by_size if r.defer_staging]
-        self.staging_tasks: Dict[asyncio.Task, Tuple[WriteReq, int, float]] = {}
-        self.ready_for_io: Deque[Tuple[str, object]] = deque()
-        self.io_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
-        # Streamed requests: one task drives the whole chunk stream
-        # (staging producer + append consumer + commit) and does its own
-        # per-chunk budget accounting.
-        self.stream_tasks: Dict[asyncio.Task, Tuple[WriteReq, float]] = {}
         self.bytes_staged = 0
         self.staged_ts: Optional[float] = None
         self.executor: Optional[ThreadPoolExecutor] = None
-        self.reporter = _ProgressReporter(rank, "write")
         self.checksums: Dict[str, list] = {}
         self._crc_executor: Optional[ThreadPoolExecutor] = None
-        # Per-task (t0, t1) intervals for the two streams, recorded in BOTH
-        # run_until_staged and run_to_completion — a sync take does all its
-        # staging before the drain loop, so recording only there would
-        # report an empty staging stream for exactly the takes whose
-        # regressions need attributing. When a telemetry session is active
-        # the same intervals are also exported as scheduler.stage /
-        # scheduler.io spans; disabled, they stay plain tuples (no Span
-        # allocation on the hot path).
         self._tm = telemetry.get_active()
-        self._stage_intervals: List[Tuple[float, float]] = []
-        self._io_intervals: List[Tuple[float, float]] = []
         # Parallel D2H lanes + stage-time attribution, exposed to stagers
-        # via the d2h contextvar around staging-task creation. Lane-window
+        # via the d2h contextvar around node-task creation. Lane-window
         # admissions (look-ahead host buffers) debit THIS pipeline's budget
-        # and are fully released by stream cleanup / _abort_inflight, so
-        # budget_balanced still holds on every path.
+        # and are fully released by stream cleanup / the engine abort sweep,
+        # so budget_balanced still holds on every path.
         self._staging_ctx = d2h.StagingContext(
             lanes=self.pools.transfer_lanes(),
             times=d2h.StageTimes(tm=self._tm),
         )
+
+        def _max_io() -> int:
+            return knobs.get_max_concurrent_io_for(self.storage)
+
+        self._engine = GraphExecutor(
+            budget_bytes=memory_budget_bytes,
+            rank=rank,
+            owner=f"write@rank{rank}",
+            kind="write",
+            span_prefix="scheduler",
+            priority=priority,
+            caps={"staging": None, "streaming": _max_io, "io": _max_io},
+            ready_label="ready_for_io",
+            progress=self.progress,
+            bytes_done=lambda: self.bytes_staged,
+            task_context=self._staging_scope,
+            on_progress=self._after_reap,
+        )
+        self.budget = self._engine.budget
         self._staging_ctx.lanes.bind_budget(
             self.budget.debit,
             self.budget.credit,
             headroom=lambda: self.budget.available,
         )
-        # Accounting windows: the wait loops' [start, end] spans. Stats
-        # attribute only in-window activity (the async gap between capture
-        # point and background drain is nobody's time).
-        self._windows: List[Tuple[float, float]] = []
         # Populated by run_to_completion: how well the pipeline overlapped
         # its two streams (D2H+serialize staging vs storage writes). The
         # 7B-scale exposure is drain throughput, so the overlap efficiency
         # must be observable, not asserted. drain_stats covers the
         # run_to_completion call only; pipeline_stats the whole pipeline.
-        # Both are derived views over the recorded stream intervals (the
-        # same data the telemetry trace exports as spans).
+        # Both are derived views over the engine's recorded stream
+        # intervals (the same data the telemetry trace exports as spans).
         self.drain_stats: Dict[str, float] = {}
         self.pipeline_stats: Dict[str, float] = {}
+        # Graph building is LAZY (first run call): stream eligibility and
+        # chunk sizing read knobs the caller overrides around the pipeline
+        # run, exactly like the legacy dispatch-time reads did.
+        self._write_reqs = write_reqs
+        self._built = False
 
-    def _record_task(self, kind: str, t0: float, path: str, nbytes: int) -> None:
-        """One finished staging/io task (or streamed chunk): record its
-        interval (stats) and, when telemetry is on, the corresponding
-        scheduler span. ``stream_chunk`` intervals join the STAGING stream
-        and a streamed request's appends join the IO stream, so the
-        overlap stats attribute streamed chunks to both streams."""
-        t1 = time.monotonic()
-        if kind == "io":
-            self._io_intervals.append((t0, t1))
-        else:  # "stage" | "stream_chunk"
-            self._stage_intervals.append((t0, t1))
-        tm = self._tm
-        if tm is not None:
-            tm.add_span(
-                f"scheduler.{kind}",
-                "scheduler",
-                t0,
-                t1 - t0,
-                {"path": path, "nbytes": nbytes, "rank": self.rank},
-            )
+    def _build_graph(self) -> None:
+        """Lower every request onto the engine graph, big first: they
+        dominate the critical path and admit small ones into the leftover
+        budget."""
+        if self._built:
+            return
+        self._built = True
+        self._stream_chunk = knobs.get_stream_chunk_bytes()
+        self._stream_inflight = knobs.get_stream_inflight()
+        by_size = sorted(
+            self._write_reqs,
+            key=lambda r: -r.buffer_stager.get_staging_cost_bytes(),
+        )
+        self._write_reqs = []
+        for req in by_size:
+            self._add_request(req)
 
-    def _occupancy(self) -> Dict[str, int]:
-        """Requests per pipeline stage — the reporter's and the stall
-        watchdog's shared view of where work is sitting."""
-        return {
-            "pending": len(self.pending),
-            "deferred": len(self.deferred),
-            "staging": len(self.staging_tasks),
-            "streaming": len(self.stream_tasks),
-            "ready_for_io": len(self.ready_for_io),
-            "io": len(self.io_tasks),
-        }
+    # ----------------------------------------------------- engine plumbing
 
-    def _report(self) -> None:
-        self.reporter.maybe_report(self._occupancy(), self.bytes_staged, self.budget)
+    def _staging_scope(self):
+        """Context manager applied around node-task creation so every
+        stager (and the sub-tasks it spawns) sees the transfer lanes +
+        interval sink via ``d2h.get_active()`` — no signature change to the
+        stager protocol."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            token = d2h.activate(self._staging_ctx)
+            try:
+                yield
+            finally:
+                d2h.deactivate(token)
+
+        return scope()
+
+    # Engine interval/window views — the telemetry artifact summary and the
+    # stats derivation read these (one source of truth: the engine).
+    @property
+    def _windows(self) -> List[Tuple[float, float]]:
+        return self._engine.windows
+
+    @property
+    def _stage_intervals(self) -> List[Tuple[float, float]]:
+        return self._engine.stage_intervals
+
+    @property
+    def _io_intervals(self) -> List[Tuple[float, float]]:
+        return self._engine.io_intervals
+
+    def _after_reap(self) -> None:
+        self._publish_progress()
+        self._maybe_mark_staged()
 
     def _publish_progress(self) -> None:
         """Mirror the progress counters as gauges when a session is on, so
@@ -509,8 +401,10 @@ class _WritePipeline:
         tm.metrics.gauge("progress.bytes_written").set(p.bytes_written)
         tm.metrics.gauge("progress.requests_done").set(p.requests_done)
 
+    # ------------------------------------------------------- graph building
+
     def _stream_eligible(self, req: WriteReq) -> bool:
-        """Whether this request goes through the chunk-streaming path:
+        """Whether this request lowers onto the chunk-streaming node:
         stager and storage both support it, it is big enough that a second
         chunk exists to overlap with, and the take has no incremental base
         (dedup must see the whole object's digest BEFORE deciding link-in
@@ -522,88 +416,92 @@ class _WritePipeline:
         if self._base_loader is not None:
             return False
         stager = req.buffer_stager
-        if stager.get_staging_cost_bytes() < 2 * knobs.get_stream_chunk_bytes():
+        if stager.get_staging_cost_bytes() < 2 * self._stream_chunk:
             return False
         return stager.can_stream()
 
-    def _dispatch_staging(self) -> None:
-        # Staging tasks are created under the pipeline's StagingContext:
-        # ensure_future snapshots the contextvar, so every stager (and the
-        # sub-tasks it spawns) sees the transfer lanes + interval sink via
-        # d2h.get_active() — no signature change to the stager protocol.
-        token = d2h.activate(self._staging_ctx)
-        try:
-            self._dispatch_staging_inner()
-        finally:
-            d2h.deactivate(token)
-
-    def _dispatch_staging_inner(self) -> None:
-        if self.executor is None:
-            self.executor = self.pools.staging_executor()
-        max_io = knobs.get_max_concurrent_io_for(self.storage)
-        while self.pending:
-            req = self.pending[0]
-            stream = self._stream_eligible(req)
-            cost = req.buffer_stager.get_staging_cost_bytes()
-            if stream:
-                if len(self.stream_tasks) >= max_io:
-                    break  # wait for a stream slot
-                # Streamed requests are admitted at their steady-state
-                # footprint (inflight x chunk), not their full size — that
-                # is the RAM win; _stream_one re-debits per chunk. Stagers
-                # that materialize one full host buffer and stream views of
-                # it stay admitted at full cost.
-                if not req.buffer_stager.stream_holds_full_buffer:
-                    cost = min(
-                        cost,
-                        knobs.get_stream_chunk_bytes()
-                        * knobs.get_stream_inflight(),
-                    )
-            over_budget = cost > self.budget.available
-            pipeline_empty = (
-                not self.staging_tasks
-                and not self.io_tasks
-                and not self.stream_tasks
+    def _add_request(self, req: WriteReq) -> None:
+        cost = req.buffer_stager.get_staging_cost_bytes()
+        if self._stream_eligible(req):
+            # Streamed requests are admitted at their steady-state
+            # footprint (inflight x chunk), not their full size — that
+            # is the RAM win; _stream_one re-debits per chunk. Stagers
+            # that materialize one full host buffer and stream views of
+            # it stay admitted at full cost.
+            if not req.buffer_stager.stream_holds_full_buffer:
+                cost = min(cost, self._stream_chunk * self._stream_inflight)
+            self._engine.add(
+                Node(
+                    "stream",
+                    self._make_stream_body(req),
+                    cost_bytes=cost,
+                    pool="streaming",
+                    path=req.path,
+                    deferred=req.defer_staging,
+                    self_budget=True,
+                    record_span=False,
+                )
             )
-            if over_budget and not pipeline_empty:
-                break
-            self.pending.popleft()
-            # Debit only once the task object exists, immediately before the
-            # task-table handoff: if coroutine construction raises, no
-            # reservation has been made yet, so nothing can leak (the task
-            # tables are what _reap/_abort_inflight sweep credits from).
-            if stream:
-                # `started` marks whether the coroutine ever ran: an abort
-                # that cancels a never-started stream must credit its
-                # admission reservation itself (the coroutine's own
-                # finally-credits never execute).
-                started = [False]
-                task = asyncio.ensure_future(
-                    self._stream_one(req, cost, started)
-                )
-                self.budget.debit(cost)
-                self.stream_tasks[task] = (req, time.monotonic(), cost, started)
-            else:
-                task = asyncio.ensure_future(
-                    req.buffer_stager.stage_buffer(self.executor)
-                )
-                self.budget.debit(cost)
-                self.staging_tasks[task] = (req, cost, time.monotonic())
+            return
+        io_node = Node(
+            "io",
+            self._make_io_body(req),
+            pool="io",
+            stream="io",
+            path=req.path,
+        )
+        self._engine.add(
+            Node(
+                "stage",
+                self._make_stage_body(req, cost),
+                cost_bytes=cost,
+                pool="staging",
+                stream="stage",
+                path=req.path,
+                deferred=req.defer_staging,
+                successor=io_node,
+            )
+        )
 
-    def _dispatch_io(self) -> None:
-        max_io = knobs.get_max_concurrent_io_for(self.storage)
-        while self.ready_for_io and len(self.io_tasks) < max_io:
-            path, buf = self.ready_for_io.popleft()
+    def _make_stage_body(self, req: WriteReq, cost: int):
+        async def stage(ctx, _payload):
+            if self.executor is None:
+                self.executor = self.pools.staging_executor()
+            buf = await req.buffer_stager.stage_buffer(self.executor)
             nbytes = memoryview(buf).nbytes
-            task = asyncio.ensure_future(self._write_one(path, buf))
-            self.io_tasks[task] = (nbytes, time.monotonic(), path)
+            self.bytes_staged += nbytes
+            self.progress.note_staged(nbytes, estimate=cost)
+            # Correct the estimate to the real footprint; the corrected
+            # reservation rides the edge to the io node.
+            ctx.recost(nbytes)
+            return buf
 
-    async def _stream_one(
-        self,
-        req: WriteReq,
-        admitted_cost: int,
-        started: Optional[list] = None,
-    ) -> None:
+        return stage
+
+    def _make_io_body(self, req: WriteReq):
+        async def io(_ctx, buf):
+            # The staged buffer's reservation is credited by the engine
+            # whether the write lands or fails (edge-final semantics).
+            try:
+                await self._write_one(req.path, buf)
+            finally:
+                nbytes = memoryview(buf).nbytes
+                self.progress.note_written(nbytes)
+            self.progress.note_request_done()
+
+        return io
+
+    def _make_stream_body(self, req: WriteReq):
+        async def stream(ctx, _payload):
+            if self.executor is None:
+                self.executor = self.pools.staging_executor()
+            await self._stream_one(ctx, req)
+
+        return stream
+
+    # ----------------------------------------------------------- node bodies
+
+    async def _stream_one(self, ctx, req: WriteReq) -> None:
         """Drive ONE streamed request end to end: a staging producer
         (``stage_chunks``) and an append consumer connected by a bounded
         queue, so the storage write of chunk *k* overlaps the
@@ -614,13 +512,14 @@ class _WritePipeline:
         full size. Per-object digests fold incrementally (running crc32 +
         sha256 over the chunk sequence == the whole object's digest), and a
         mid-stream failure aborts the storage stream — no partial object is
-        ever committed."""
-        if started is not None:
-            started[0] = True
+        ever committed. The producer passes a preemption point before each
+        chunk: a higher QoS class arriving mid-stream steals the next chunk
+        admission."""
         stager = req.buffer_stager
         budget = self.budget
-        chunk_est = knobs.get_stream_chunk_bytes()
-        inflight = knobs.get_stream_inflight()
+        chunk_est = self._stream_chunk
+        inflight = self._stream_inflight
+        admitted_cost = ctx.reservation
         holds_full = stager.stream_holds_full_buffer
         if not holds_full:
             # Hand the admission reservation over to per-chunk accounting.
@@ -663,6 +562,9 @@ class _WritePipeline:
             agen = stager.stage_chunks(self.executor)
             try:
                 while True:
+                    # Chunk-granular QoS yield: a foreground class arriving
+                    # mid-drain pauses the NEXT chunk, not the stream.
+                    await ctx.preemption_point()
                     if not holds_full:
                         budget.debit(chunk_est)
                         outstanding += chunk_est
@@ -681,7 +583,7 @@ class _WritePipeline:
                         budget.debit(nbytes)
                         outstanding += nbytes - chunk_est
                     chunks += 1
-                    self._record_task("stream_chunk", t0, req.path, nbytes)
+                    ctx.record_interval("stream_chunk", t0, req.path, nbytes)
                     self.progress.note_staged(nbytes)
                     await queue.put((buf, nbytes))
             finally:
@@ -712,7 +614,7 @@ class _WritePipeline:
                     await hasher.feed(buf)
                 t0 = time.monotonic()
                 await stream.append(buf)
-                self._record_task("io", t0, req.path, nbytes)
+                ctx.record_interval("io", t0, req.path, nbytes)
                 total += nbytes
                 self.progress.note_written(nbytes)
                 if not holds_full:
@@ -725,7 +627,7 @@ class _WritePipeline:
             await asyncio.gather(ptask, ctask)
             t0 = time.monotonic()
             await stream.commit()
-            self._record_task("io", t0, req.path, 0)
+            ctx.record_interval("io", t0, req.path, 0)
         except BaseException:
             for t in (ptask, ctask):
                 t.cancel()
@@ -927,6 +829,8 @@ class _WritePipeline:
                         return
         await self.storage.write(WriteIO(path=path, buf=buf))
 
+    # ---------------------------------------------------------------- phases
+
     @property
     def budget_balanced(self) -> bool:
         """True when every debit has been credited back — the invariant an
@@ -934,34 +838,11 @@ class _WritePipeline:
         return self.budget.available == self.budget.total
 
     async def _abort_inflight(self) -> None:
-        """Failure path: cancel every in-flight task, await them, and credit
-        back every outstanding budget debit, so an aborted take leaves the
-        budget balanced and no staging/io coroutine running against a
-        torn-down pipeline. Stream tasks that ever started credit their own
-        debits in their finally blocks; never-started ones are credited
-        here (their coroutine bodies never ran)."""
-        tasks = (
-            list(self.staging_tasks)
-            + list(self.io_tasks)
-            + list(self.stream_tasks)
-        )
-        for task in tasks:
-            task.cancel()
-        if tasks:
-            await asyncio.gather(*tasks, return_exceptions=True)
-        for _req, cost, _t0 in self.staging_tasks.values():
-            self.budget.credit(cost)
-        self.staging_tasks.clear()
-        for nbytes, _t0, _path in self.io_tasks.values():
-            self.budget.credit(nbytes)
-        self.io_tasks.clear()
-        for _req, _t0, cost, started in self.stream_tasks.values():
-            if not started[0]:
-                self.budget.credit(cost)
-        self.stream_tasks.clear()
-        while self.ready_for_io:
-            _path, buf = self.ready_for_io.popleft()
-            self.budget.credit(memoryview(buf).nbytes)
+        """Failure path: the engine's abort sweep (cancel, await, credit
+        every outstanding reservation), plus this pipeline's lane-window
+        sweep — so an aborted take leaves the budget balanced and no
+        staging/io coroutine running against a torn-down pipeline."""
+        await self._engine.abort()
         # Look-ahead transfers the cancelled streams didn't get to release
         # themselves (their cleanup normally does) — sweep the remainder so
         # the budget balances on every failure path.
@@ -971,124 +852,50 @@ class _WritePipeline:
         # (chained onto the failure that triggered the abort).
         self.budget.assert_balanced("write pipeline abort")
 
-    def _reap(self, done) -> None:
-        for task in done:
-            if task in self.staging_tasks:
-                req, cost, t0 = self.staging_tasks.pop(task)
-                try:
-                    buf = task.result()
-                except BaseException:
-                    # Failed staging releases its reservation: the task is
-                    # already popped, so nobody else can credit it.
-                    self.budget.credit(cost)
-                    raise
-                nbytes = memoryview(buf).nbytes
-                self._record_task("stage", t0, req.path, nbytes)
-                self.bytes_staged += nbytes
-                self.progress.note_staged(nbytes, estimate=cost)
-                # Correct the estimate to the real footprint.
-                self.budget.credit(cost)
-                self.budget.debit(nbytes)
-                self.ready_for_io.append((req.path, buf))
-            elif task in self.stream_tasks:
-                # Intervals, budget, byte counts, and progress were recorded
-                # inside _stream_one chunk by chunk; only failures remain.
-                self.stream_tasks.pop(task)
-                task.result()  # propagate failures
-            else:
-                nbytes, t0, path = self.io_tasks.pop(task)
-                try:
-                    task.result()  # propagate failures
-                finally:
-                    # The staged buffer is released whether the write landed
-                    # or failed — credit on both paths (popped above, so no
-                    # other path can).
-                    self.budget.credit(nbytes)
-                self._record_task("io", t0, path, nbytes)
-                self.progress.note_written(nbytes)
-                self.progress.note_request_done()
-        if done:
-            self._publish_progress()
+    def _maybe_mark_staged(self) -> None:
+        if (
+            self.staged_ts is None
+            and not self._engine._deferred
+            and self._engine.unfinished_in(_STAGE_POOLS) == 0
+        ):
+            self.staged_ts = time.monotonic()
+            logger.info(
+                "Rank %d staged %.2f GB in %.2fs",
+                self.rank,
+                self.bytes_staged / 1e9,
+                self.staged_ts - self.begin_ts,
+            )
 
     async def run_until_staged(self) -> None:
-        """Drive the pipeline to the capture point: every *non-deferred*
+        """Drive the graph to the capture point: every *non-deferred*
         request's bytes are privately held in host RAM. Deferred requests
-        (immutable device-backed data) then join the queue for the
-        background drain."""
-        window_t0 = time.monotonic()
-        watchdog_task = self._spawn_watchdog()
+        (immutable device-backed data) then become admissible for the
+        background drain. Stream nodes admitted here (sync takes' big host
+        arrays) finish before the capture point too: their source is read
+        until the last chunk stages, and by the time they complete the
+        bytes are durably written — strictly stronger capture."""
+        self._build_graph()
         try:
-            if self.pending:
-                self._dispatch_staging()
-            # Stream tasks admitted here (sync takes' big host arrays) must
-            # finish before the capture point too: their source is read
-            # until the last chunk stages, and by the time they complete
-            # the bytes are durably written — strictly stronger capture.
-            while self.staging_tasks or self.pending or self.stream_tasks:
-                done, _ = await asyncio.wait(
-                    set(self.staging_tasks.keys())
-                    | set(self.io_tasks.keys())
-                    | set(self.stream_tasks.keys()),
-                    return_when=asyncio.FIRST_COMPLETED,
-                    # Bounded so the reporter fires during a stall (when no
-                    # task completes, wait returns with done == set()).
-                    timeout=self.reporter.interval_s,
-                )
-                self._reap(done)
-                self._dispatch_io()
-                self._dispatch_staging()
-                self._report()
+            await self._engine.run(
+                until=lambda: self._engine.unfinished_in(_STAGE_POOLS) == 0
+            )
         except BaseException:
             await self._abort_inflight()
             self._shutdown_executor(failed=True)
             raise
-        finally:
-            await self._reap_watchdog(watchdog_task)
-            self._windows.append((window_t0, time.monotonic()))
-        if self.deferred:
-            self.pending.extend(self.deferred)
-            self.deferred = []
-        else:
-            self._mark_staged()
+        self._engine.release_deferred()
+        self._maybe_mark_staged()
 
     async def run_to_completion(self) -> None:
-        """Drive the pipeline (staging and I/O) until everything is written."""
+        """Drive the graph (staging and I/O) until everything is written."""
         # Window bookkeeping: drain_stats reports THIS call's window only
         # (for async takes, the background drain — any host-entry staging
         # billed during the stall must not deflate the apparent drain
         # rate), while pipeline_stats covers every window for sync takes.
-        drain_t0 = time.monotonic()
-        watchdog_task = self._spawn_watchdog()
+        self._build_graph()
         try:
-            if self.pending or self.staging_tasks:
-                self._dispatch_staging()
-            self._dispatch_io()
-            while (
-                self.staging_tasks
-                or self.pending
-                or self.io_tasks
-                or self.ready_for_io
-                or self.stream_tasks
-            ):
-                done, _ = await asyncio.wait(
-                    set(self.staging_tasks.keys())
-                    | set(self.io_tasks.keys())
-                    | set(self.stream_tasks.keys()),
-                    return_when=asyncio.FIRST_COMPLETED,
-                    # Bounded so the reporter fires during a stall (when no
-                    # task completes, wait returns with done == set()).
-                    timeout=self.reporter.interval_s,
-                )
-                self._reap(done)
-                self._dispatch_io()
-                self._dispatch_staging()
-                self._report()
-                if (
-                    not self.staging_tasks
-                    and not self.pending
-                    and not self.stream_tasks
-                ):
-                    self._mark_staged()
+            self._engine.release_deferred()
+            await self._engine.run()
             # The sidecar write/delete below is real storage time: recorded
             # as an io interval so wall_s (and the drain rate derived from
             # it) doesn't silently exclude the post-loop tail.
@@ -1103,7 +910,7 @@ class _WritePipeline:
                 await self.storage.write(
                     WriteIO(path=sidecar_path, buf=payload)
                 )
-                self._record_task(
+                self._engine.record_interval(
                     "io", sidecar_t0, sidecar_path, len(payload)
                 )
             else:
@@ -1132,22 +939,26 @@ class _WritePipeline:
                         exc_info=True,
                     )
         except BaseException:
-            # Error path: cancel in-flight tasks (crediting their budget
-            # debits) and queued staging/hash thunks so nothing runs
-            # against a torn-down pipeline.
+            # Error path: the engine sweep cancels in-flight nodes
+            # (crediting their reservations) and queued staging/hash thunks
+            # so nothing runs against a torn-down pipeline.
             await self._abort_inflight()
-            await self._reap_watchdog(watchdog_task)
             self._shutdown_executor(failed=True)
             raise
-        await self._reap_watchdog(watchdog_task)
         self._shutdown_executor()
         # Debug-ledger cross-check: a completed drain has credited every
         # debit (request admissions, streamed chunks, lane-window
         # look-ahead) — zero outstanding bytes at pipeline close.
         self.budget.assert_balanced("write pipeline close")
 
-        drain_window = (drain_t0, time.monotonic())
-        self._windows.append(drain_window)
+        # Extend this run's accounting window over the sidecar tail, then
+        # derive the stats views.
+        windows = self._engine.windows
+        if windows:
+            windows[-1] = (windows[-1][0], time.monotonic())
+            drain_window = windows[-1]
+        else:  # pragma: no cover - run() always records a window
+            drain_window = (self.begin_ts, time.monotonic())
         # drain_stats: this call's window only (the async background drain).
         self.drain_stats = _stream_stats(
             [drain_window], self._stage_intervals, self._io_intervals
@@ -1155,7 +966,7 @@ class _WritePipeline:
         # pipeline_stats: run_until_staged + drain — the whole pipeline, so
         # a SYNC take's staging (done before its drain loop) is attributed.
         self.pipeline_stats = _stream_stats(
-            self._windows, self._stage_intervals, self._io_intervals
+            windows, self._stage_intervals, self._io_intervals
         )
         # Decompose stage_busy into its sub-streams (D2H resolve, serialize/
         # compress, hash fold) from the StageTimes intervals — same union/
@@ -1171,7 +982,7 @@ class _WritePipeline:
             )
             self.pipeline_stats[f"stage_{kind}_s"] = sum(
                 _measure(_clip_merged(merged, w0, w1))
-                for w0, w1 in self._windows
+                for w0, w1 in windows
             )
         # Pipeline-level metrics (no-ops unless a telemetry session is on).
         telemetry.gauge_max(
@@ -1209,48 +1020,6 @@ class _WritePipeline:
                 ps["overlap_s"],
                 efficiency * 100,
                 ps["idle_s"],
-            )
-
-    def _spawn_watchdog(self) -> Optional[asyncio.Task]:
-        """Opt-in liveness: one structured warning per stall (no byte
-        progress for TORCHSNAPSHOT_TPU_STALL_WARN_S seconds). Armed around
-        BOTH wait loops — a sync take's streams complete inside
-        run_until_staged, so covering only the drain would leave exactly
-        the hung-stream case unwatched there. The caller retains the task
-        and reaps it (``_reap_watchdog``) on every exit path."""
-        warn_s = knobs.get_stall_warn_s()
-        if warn_s <= 0:
-            return None
-        watchdog = telemetry.StallWatchdog(
-            self.progress,
-            warn_s,
-            occupancy=self._occupancy,
-            rank=self.rank,
-            on_fire=lambda: telemetry.counter_add(
-                "scheduler.stall_warnings", 1
-            ),
-        )
-        return asyncio.ensure_future(watchdog.run())
-
-    @staticmethod
-    async def _reap_watchdog(task: Optional[asyncio.Task]) -> None:
-        if task is not None:
-            task.cancel()
-            await asyncio.gather(task, return_exceptions=True)
-
-    def _mark_staged(self) -> None:
-        if (
-            self.staged_ts is None
-            and not self.staging_tasks
-            and not self.pending
-            and not self.stream_tasks
-        ):
-            self.staged_ts = time.monotonic()
-            logger.info(
-                "Rank %d staged %.2f GB in %.2fs",
-                self.rank,
-                self.bytes_staged / 1e9,
-                self.staged_ts - self.begin_ts,
             )
 
     def _shutdown_executor(self, failed: bool = False) -> None:
@@ -1352,13 +1121,16 @@ async def execute_write_reqs(
         Callable[[], Optional[Tuple[str, Dict[str, list]]]]
     ] = None,
     pools: Optional[PipelinePools] = None,
+    priority: Optional[Priority] = None,
 ) -> PendingIOWork:
     """Runs to the capture point (all non-deferred requests staged) and
     returns a :class:`PendingIOWork` that drains the rest (deferred staging +
     all storage I/O). ``base_loader`` lazily yields (base snapshot root,
     merged digest map) for incremental takes: byte-identical objects are
     hard-linked, not rewritten. ``pools``: thread pools shared with the
-    operation's other pipelines (owned, and torn down, by the caller)."""
+    operation's other pipelines (owned, and torn down, by the caller).
+    ``priority``: the pipeline's QoS class (default: the ambient
+    ``engine.qos`` scope, NORMAL outside any scope)."""
     pipeline = _WritePipeline(
         write_reqs,
         storage,
@@ -1366,6 +1138,7 @@ async def execute_write_reqs(
         rank,
         base_loader=base_loader,
         pools=pools,
+        priority=priority,
     )
     await pipeline.run_until_staged()
     return PendingIOWork(pipeline)
@@ -1381,6 +1154,7 @@ def sync_execute_write_reqs(
         Callable[[], Optional[Tuple[str, Dict[str, list]]]]
     ] = None,
     pools: Optional[PipelinePools] = None,
+    priority: Optional[Priority] = None,
 ) -> PendingIOWork:
     return event_loop.run_until_complete(
         execute_write_reqs(
@@ -1390,6 +1164,7 @@ def sync_execute_write_reqs(
             rank,
             base_loader=base_loader,
             pools=pools,
+            priority=priority,
         )
     )
 
@@ -1462,11 +1237,18 @@ async def execute_read_reqs(
     rank: int,
     pools: Optional[PipelinePools] = None,
     digests: Optional[Dict[str, object]] = None,
+    priority: Optional[Priority] = None,
 ) -> Dict[str, float]:
-    """Drive the read pipeline to completion. Returns this pipeline's
+    """Drive the read graph to completion. Returns this pipeline's
     accounting — ``{"bytes_read", "wall_s", "requests"}`` — so restore
     callers can aggregate a restore-side record (bench regression gate,
     persisted artifacts) without a telemetry session.
+
+    Each request lowers onto a ``read_io → consume`` engine chain: the
+    fetch is admitted under the consuming budget (the reservation rides
+    the edge until the consume completes), capped at the storage plugin's
+    IO concurrency, and — at FOREGROUND priority — preempts any
+    lower-class engine's next admission in this process.
 
     Fault tolerance: every request retries transient local OSErrors
     (stale NFS handles, timeouts — the same classification the fs plugin
@@ -1480,21 +1262,12 @@ async def execute_read_reqs(
     mismatch raises :class:`ReadVerificationError` — the restore aborts
     instead of consuming silently corrupt bytes."""
     begin_ts = time.monotonic()
-    budget = _Budget(memory_budget_bytes, owner=f"read@rank{rank}")
-    pending: Deque[ReadReq] = deque(
-        sorted(read_reqs, key=lambda r: -r.buffer_consumer.get_consuming_cost_bytes())
-    )
-    io_tasks: Dict[asyncio.Task, Tuple[ReadReq, int, float]] = {}
-    consume_tasks: Dict[asyncio.Task, Tuple[int, float, str]] = {}
-    bytes_read = 0
     # One consuming pool per operation: restores with many statefuls reuse
     # the caller's pools instead of constructing one per read pipeline.
     owns_pools = pools is None
     if owns_pools:
         pools = PipelinePools()
     executor = pools.consuming_executor()
-    reporter = _ProgressReporter(rank, "read")
-    tm = telemetry.get_active()
     # One window for the pipeline: any request starting or succeeding is
     # collective progress, so a transient storm retries while the backend
     # still moves bytes for peers and gives up ~window after a total stall.
@@ -1505,151 +1278,119 @@ async def execute_read_reqs(
         from .storage_plugins.cache import find_read_cache
 
         quarantine_cache = find_read_cache(storage)
+    totals = {"bytes_read": 0}
+    eng = GraphExecutor(
+        budget_bytes=memory_budget_bytes,
+        rank=rank,
+        owner=f"read@rank{rank}",
+        kind="read",
+        span_prefix="scheduler",
+        priority=priority,
+        caps={
+            "io": lambda: knobs.get_max_concurrent_io_for(storage),
+            "consume": None,
+        },
+        ready_label="consume_ready",
+        bytes_done=lambda: totals["bytes_read"],
+    )
 
     async def fetch(req: ReadReq) -> ReadIO:
         return await fetch_read_io(
             storage, req.path, req.byte_range, read_progress
         )
 
-    async def read_one(req: ReadReq) -> object:
-        read_io = await fetch(req)
-        want = _read_digest_record(digests, req.path) if verify_reads else None
-        checker = (
-            _verify_checker(want, req.byte_range) if want is not None else None
-        )
-        if checker is not None:
-            loop = asyncio.get_running_loop()
-            problem = await loop.run_in_executor(
-                executor, checker, read_io.buf.getbuffer()
+    def make_read_body(req: ReadReq):
+        async def read_one(ctx, _payload):
+            read_io = await fetch(req)
+            want = (
+                _read_digest_record(digests, req.path) if verify_reads else None
             )
-            if problem is not None:
-                telemetry.counter_add("scheduler.read_verify_failures")
-                logger.warning(
-                    "read of %s failed digest verification (%s); "
-                    "quarantining cache entries and re-fetching once",
-                    req.path,
-                    problem,
-                )
-                if quarantine_cache is not None:
-                    await loop.run_in_executor(
-                        executor, quarantine_cache.quarantine_path, req.path
-                    )
-                read_io = await fetch(req)
+            checker = (
+                _verify_checker(want, req.byte_range)
+                if want is not None
+                else None
+            )
+            if checker is not None:
+                loop = asyncio.get_running_loop()
                 problem = await loop.run_in_executor(
                     executor, checker, read_io.buf.getbuffer()
                 )
                 if problem is not None:
                     telemetry.counter_add("scheduler.read_verify_failures")
-                    raise ReadVerificationError(
-                        f"read of {req.path} failed digest verification "
-                        f"twice ({problem}); persistent corruption at the "
-                        "source — aborting instead of restoring bad bytes"
+                    logger.warning(
+                        "read of %s failed digest verification (%s); "
+                        "quarantining cache entries and re-fetching once",
+                        req.path,
+                        problem,
                     )
-        return read_io.buf.getbuffer()
+                    if quarantine_cache is not None:
+                        await loop.run_in_executor(
+                            executor,
+                            quarantine_cache.quarantine_path,
+                            req.path,
+                        )
+                    read_io = await fetch(req)
+                    problem = await loop.run_in_executor(
+                        executor, checker, read_io.buf.getbuffer()
+                    )
+                    if problem is not None:
+                        telemetry.counter_add("scheduler.read_verify_failures")
+                        raise ReadVerificationError(
+                            f"read of {req.path} failed digest verification "
+                            f"twice ({problem}); persistent corruption at the "
+                            "source — aborting instead of restoring bad bytes"
+                        )
+            buf = read_io.buf.getbuffer()
+            nbytes = memoryview(buf).nbytes
+            totals["bytes_read"] += nbytes
+            ctx.note_bytes(nbytes)
+            return buf
 
-    def dispatch_reads() -> None:
-        max_io = knobs.get_max_concurrent_io_for(storage)
-        while pending and len(io_tasks) < max_io:
-            cost = pending[0].buffer_consumer.get_consuming_cost_bytes()
-            over_budget = cost > budget.available
-            pipeline_empty = not io_tasks and not consume_tasks
-            if over_budget and not pipeline_empty:
-                break
-            req = pending.popleft()
-            # Task first, debit second (see _dispatch_staging_inner): a
-            # failed coroutine construction must not strand a reservation.
-            task = asyncio.ensure_future(read_one(req))
-            budget.debit(cost)
-            io_tasks[task] = (req, cost, time.monotonic())
+        return read_one
+
+    def make_consume_body(req: ReadReq):
+        async def consume(_ctx, buf):
+            await req.buffer_consumer.consume_buffer(buf, executor)
+
+        return consume
+
+    for req in sorted(
+        read_reqs, key=lambda r: -r.buffer_consumer.get_consuming_cost_bytes()
+    ):
+        consume_node = Node(
+            "consume", make_consume_body(req), pool="consume", path=req.path
+        )
+        eng.add(
+            Node(
+                "read_io",
+                make_read_body(req),
+                cost_bytes=req.buffer_consumer.get_consuming_cost_bytes(),
+                pool="io",
+                path=req.path,
+                successor=consume_node,
+            )
+        )
 
     try:
-        dispatch_reads()
-        while io_tasks or consume_tasks or pending:
-            done, _ = await asyncio.wait(
-                set(io_tasks.keys()) | set(consume_tasks.keys()),
-                return_when=asyncio.FIRST_COMPLETED,
-                timeout=reporter.interval_s,
-            )
-            for task in done:
-                if task in io_tasks:
-                    req, cost, t0 = io_tasks.pop(task)
-                    try:
-                        buf = task.result()
-                    except BaseException:
-                        # Already popped, so the abort sweep below can't
-                        # see this task: credit its reservation here or the
-                        # debit leaks (found by the budget ledger under the
-                        # restore chaos matrix).
-                        budget.credit(cost)
-                        raise
-                    nbytes = memoryview(buf).nbytes
-                    bytes_read += nbytes
-                    if tm is not None:
-                        tm.add_span(
-                            "scheduler.read_io",
-                            "scheduler",
-                            t0,
-                            time.monotonic() - t0,
-                            {"path": req.path, "nbytes": nbytes, "rank": rank},
-                        )
-                    consume_tasks[
-                        asyncio.ensure_future(
-                            req.buffer_consumer.consume_buffer(buf, executor)
-                        )
-                    ] = (cost, time.monotonic(), req.path)
-                else:
-                    cost, t0, path = consume_tasks.pop(task)
-                    try:
-                        task.result()
-                    finally:
-                        # Credited whether the consume landed or failed —
-                        # popped above, so no other path can.
-                        budget.credit(cost)
-                    if tm is not None:
-                        tm.add_span(
-                            "scheduler.consume",
-                            "scheduler",
-                            t0,
-                            time.monotonic() - t0,
-                            {"path": path, "rank": rank},
-                        )
-            dispatch_reads()
-            reporter.maybe_report(
-                {
-                    "pending": len(pending),
-                    "io": len(io_tasks),
-                    "consume": len(consume_tasks),
-                },
-                bytes_read,
-                budget,
-            )
+        await eng.run()
     except BaseException:
-        # Error path: cancel in-flight reads/consumes (crediting their
-        # budget debits) and queued consumer thunks — nothing may run
-        # against a torn-down pipeline.
-        inflight = list(io_tasks) + list(consume_tasks)
-        for task in inflight:
-            task.cancel()
-        if inflight:
-            await asyncio.gather(*inflight, return_exceptions=True)
-        for _req, cost, _t0 in io_tasks.values():
-            budget.credit(cost)
-        for cost, _t0, _path in consume_tasks.values():
-            budget.credit(cost)
-        io_tasks.clear()
-        consume_tasks.clear()
+        # Error path: the engine sweep cancels in-flight reads/consumes
+        # (crediting their reservations) and queued consumer thunks —
+        # nothing may run against a torn-down pipeline.
+        await eng.abort()
         pools.shutdown(cancel_queued=True)
         # Debug-ledger cross-check (chains onto the original failure).
-        budget.assert_balanced("read pipeline abort")
+        eng.assert_balanced("read pipeline abort")
         raise
     else:
         if owns_pools:
             pools.shutdown()
-        budget.assert_balanced("read pipeline close")
+        eng.assert_balanced("read pipeline close")
 
+    bytes_read = totals["bytes_read"]
     elapsed = time.monotonic() - begin_ts
     telemetry.counter_add("scheduler.bytes_read", bytes_read)
-    telemetry.gauge_max("scheduler.budget_hwm_bytes", budget.high_water_bytes)
+    telemetry.gauge_max("scheduler.budget_hwm_bytes", eng.budget.high_water_bytes)
     if bytes_read:
         logger.info(
             "Rank %d read %.2f GB in %.2fs (%.2f GB/s)",
@@ -1673,6 +1414,7 @@ def sync_execute_read_reqs(
     event_loop: asyncio.AbstractEventLoop,
     pools: Optional[PipelinePools] = None,
     digests: Optional[Dict[str, object]] = None,
+    priority: Optional[Priority] = None,
 ) -> Dict[str, float]:
     return event_loop.run_until_complete(
         execute_read_reqs(
@@ -1682,5 +1424,6 @@ def sync_execute_read_reqs(
             rank,
             pools=pools,
             digests=digests,
+            priority=priority,
         )
     )

@@ -68,6 +68,7 @@ from .manifest import (
     get_manifest_for_rank,
     is_container_entry,
 )
+from .engine import qos as engine_qos
 from .parallel.coordinator import Coordinator, get_coordinator
 from .parallel.store import BarrierError, LinearBarrier
 from .partitioner import partition_write_reqs_with_assignment
@@ -115,6 +116,24 @@ LAST_SYNC_DRAIN_STATS: Dict[str, float] = {}
 # benchmark read it without needing a telemetry session. Diagnostics only:
 # overwritten per restore, per process.
 LAST_RESTORE_STATS: Dict[str, Any] = {}
+
+
+@contextlib.contextmanager
+def _qos_scope(qos: Any):
+    """Bind an operation's QoS class: the ambient priority scope (every
+    pipeline, swarm session, and origin fetch built inside inherits it) plus
+    a whole-operation demand registration, so e.g. a FOREGROUND restore
+    keeps lower-class engines paused across its planning/device_put gaps —
+    not just while its read pipelines run. ``qos`` is
+    ``"foreground" | "normal" | "background"`` (or an ``engine.Priority``);
+    None inherits the ambient class untouched."""
+    priority = engine_qos.parse_priority(qos)
+    if priority is None:
+        yield
+        return
+    with engine_qos.priority_scope(priority):
+        with engine_qos.demand_scope(priority):
+            yield
 
 
 def _restore_attribution(
@@ -427,6 +446,7 @@ class Snapshot:
         job: Optional[str] = None,
         step: Optional[int] = None,
         max_chain_len: Optional[int] = None,
+        qos: Any = None,
         _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "Snapshot":
         """``base``: path of an earlier snapshot for an INCREMENTAL take —
@@ -450,10 +470,43 @@ class Snapshot:
         of the snapshot name. Selection happens on rank 0 inside the
         preflight round, so every rank uses the same base by construction.
 
+        ``qos``: the take's QoS class (``"foreground"``/``"normal"``/
+        ``"background"``, default: the ambient class — NORMAL outside any
+        scope). A ``"background"`` take's pipeline yields its next
+        admission (budget, io/hash/transfer-pool slots, stream chunks) to
+        any higher-class operation in this process — see
+        docs/performance.md, "The dataflow engine".
+
         ``_telemetry``: a :class:`telemetry.Telemetry` session to record
         this take's spans/metrics into (semi-public; the stable switch is
         the ``TORCHSNAPSHOT_TPU_TRACE`` knob). The session is also
         published as ``Snapshot.last_telemetry``."""
+        with _qos_scope(qos):
+            return cls._take_sync(
+                path,
+                app_state,
+                coordinator,
+                replicated,
+                base,
+                job,
+                step,
+                max_chain_len,
+                _telemetry,
+            )
+
+    @classmethod
+    def _take_sync(
+        cls,
+        path: str,
+        app_state: AppState,
+        coordinator: Optional[Coordinator],
+        replicated: Optional[List[str]],
+        base: Optional[str],
+        job: Optional[str],
+        step: Optional[int],
+        max_chain_len: Optional[int],
+        _telemetry: Optional["telemetry.Telemetry"],
+    ) -> "Snapshot":
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
         rank = coord.get_rank()
@@ -568,6 +621,7 @@ class Snapshot:
         job: Optional[str] = None,
         step: Optional[int] = None,
         max_chain_len: Optional[int] = None,
+        qos: Any = None,
         _telemetry: Optional["telemetry.Telemetry"] = None,
     ) -> "PendingSnapshot":
         """Returns after planning + forking device buffers (milliseconds);
@@ -588,9 +642,41 @@ class Snapshot:
         ``job``/``step``/``max_chain_len``: catalog-managed delta chains,
         exactly as in :meth:`take`; the catalog record is appended by the
         background commit thread, after metadata lands and before the
-        commit barrier releases."""
+        commit barrier releases.
+
+        ``qos``: the take's QoS class, as in :meth:`take`. The write
+        pipeline captures it at planning time, so ``qos="background"``
+        classifies the BACKGROUND DRAIN itself: a higher-class operation
+        (e.g. a ``qos="foreground"`` restore) arriving mid-drain steals the
+        drain's next admission at chunk granularity."""
         cls._validate_app_state(app_state)
         coord = get_coordinator(coordinator)
+        with _qos_scope(qos):
+            return cls._async_take_impl(
+                path,
+                app_state,
+                coord,
+                replicated,
+                base,
+                job,
+                step,
+                max_chain_len,
+                _telemetry,
+            )
+
+    @classmethod
+    def _async_take_impl(
+        cls,
+        path: str,
+        app_state: AppState,
+        coord: Coordinator,
+        replicated: Optional[List[str]],
+        base: Optional[str],
+        job: Optional[str],
+        step: Optional[int],
+        max_chain_len: Optional[int],
+        _telemetry: Optional["telemetry.Telemetry"],
+    ) -> "PendingSnapshot":
         base = cls._maybe_auto_base(base, job, max_chain_len)
         tm, tm_prev = _begin_telemetry(_telemetry)
         try:
@@ -768,15 +854,25 @@ class Snapshot:
         replicated_paths = cls._match_replicated_paths(
             set(flattened.keys()), plan.replicated_globs
         )
+        prepare_timings: Dict[str, float] = {}
         local_manifest, write_reqs = prepare_write(
             flattened=flattened,
             rank=rank,
             world_size=world_size,
             replicated_paths=replicated_paths,
             is_async_snapshot=is_async_snapshot,
+            timings=prepare_timings,
         )
         manifest.update(local_manifest)
         _phase("prepare_write")
+        # Decompose the dominant stall phase into stage.prepare.* sub-spans
+        # (d2h_hint: the defensive device fork + transfer hints;
+        # stager_construction: per-preparer planning; plan: the remainder).
+        # Out-of-band notes: they ride the tracker's span list into
+        # LAST_TAKE_PHASES and the persisted telemetry artifact without
+        # moving the sequential phase boundary.
+        for bucket, dur in sorted(prepare_timings.items()):
+            tracker.note(f"stage.prepare.{bucket}", dur)
 
         write_reqs, assignment = partition_write_reqs_with_assignment(
             manifest,
@@ -1101,6 +1197,7 @@ class Snapshot:
         app_state: AppState,
         _telemetry: Optional["telemetry.Telemetry"] = None,
         include: Optional[List[str]] = None,
+        qos: Any = None,
     ) -> None:
         """``include``: optional list of logical-path globs (e.g.
         ``["model/encoder/*"]``) restricting the restore to the matching
@@ -1119,7 +1216,24 @@ class Snapshot:
         :class:`CheckpointAbortedError` naming the failing rank and phase
         on EVERY rank within the barrier timeout. The snapshot itself is
         read-only here and stays untouched; live state may be partially
-        loaded (restore targets must be re-restored before use)."""
+        loaded (restore targets must be re-restored before use).
+
+        ``qos``: the restore's QoS class. ``qos="foreground"`` — the
+        serving-replica restart path — registers FOREGROUND demand for the
+        WHOLE restore, so any lower-class engine in this process (a
+        background drain, scrub, gc, cache populate, a background swarm
+        fetch) pauses its next admission at chunk granularity until this
+        restore completes; see ``benchmarks/qos/`` for the measured p99
+        effect."""
+        with _qos_scope(qos):
+            self._restore_impl(app_state, _telemetry, include)
+
+    def _restore_impl(
+        self,
+        app_state: AppState,
+        _telemetry: Optional["telemetry.Telemetry"] = None,
+        include: Optional[List[str]] = None,
+    ) -> None:
         self._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
@@ -1869,60 +1983,81 @@ class Snapshot:
                     problems[location] = _uncovered_problem(location, unreadable)
 
             async def check_all() -> None:
-                # Created on the running loop. Concurrency is capped by the
-                # IO knob AND a memory budget: 16 concurrent full-object
-                # reads of 512 MB shards would otherwise buffer ~8 GB — an
-                # OOM on the small operator VMs this audit targets.
-                sem = asyncio.Semaphore(_knobs.get_max_concurrent_io_for(storage))
-                budget_total = get_process_memory_budget_bytes(None)
-                avail = budget_total
-                cond = asyncio.Condition()
+                # A BACKGROUND-class engine graph: one `verify` node per
+                # object, costed at its recorded size, capped by the IO
+                # knob AND the process memory budget (16 concurrent
+                # full-object reads of 512 MB shards would otherwise buffer
+                # ~8 GB — an OOM on the small operator VMs this audit
+                # targets) — and ledger-audited like every other pipeline.
+                # At BACKGROUND priority the audit yields its next
+                # admission to any NORMAL/FOREGROUND take or restore in
+                # this process.
+                from .engine import Node as _Node
+                from .engine import Priority as _Priority
+                from .engine import run_graph as _run_graph
 
-                async def check_one(path: str, want) -> None:
-                    nonlocal avail
+                budget_total = get_process_memory_budget_bytes(None)
+
+                def make_check(path: str, want):
+                    async def check(_ctx, _payload) -> None:
+                        read_io = ReadIO(path=path)
+                        try:
+                            await storage.read(read_io)
+                        except FileNotFoundError:
+                            problems[path] = "missing"
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            # Same distinction as for sidecars: a read
+                            # failing past the plugin's retry window is
+                            # not evidence the object is gone.
+                            problems[path] = f"unreadable ({e!r})"
+                            return
+                        got = _zlib.crc32(read_io.buf.getbuffer())
+                        # Sidecar value: bare crc int (pre-digest
+                        # snapshots), [crc, size, sha256] (v1), or a v2
+                        # tree record — whose combined crc is
+                        # bit-identical to the serial fold, so this
+                        # quick audit needs no per-chunk work.
+                        want_crc = hashing.record_crc(want)
+                        if want_crc is not None and got != want_crc:
+                            problems[path] = (
+                                f"crc mismatch (recorded {want_crc}, "
+                                f"found {got})"
+                            )
+
+                    return check
+
+                nodes = []
+                for path, want in sorted(expected.items()):
                     # Recorded size when the sidecar has one (v1 list or v2
                     # tree record); a conservative slice of the budget for
-                    # legacy int-format entries.
+                    # legacy int-format entries. Oversize objects clamp to
+                    # the whole budget and are admitted alone (the engine's
+                    # over-budget escape).
                     rec_size = hashing.record_size(want)
-                    cost = rec_size if rec_size is not None else budget_total // 8
-                    cost = min(cost, budget_total)  # oversize: admit alone
-                    async with cond:
-                        while avail < cost:
-                            await cond.wait()
-                        avail -= cost
-                    try:
-                        async with sem:
-                            read_io = ReadIO(path=path)
-                            try:
-                                await storage.read(read_io)
-                            except FileNotFoundError:
-                                problems[path] = "missing"
-                                return
-                            except Exception as e:  # noqa: BLE001
-                                # Same distinction as for sidecars: a read
-                                # failing past the plugin's retry window is
-                                # not evidence the object is gone.
-                                problems[path] = f"unreadable ({e!r})"
-                                return
-                            got = _zlib.crc32(read_io.buf.getbuffer())
-                            # Sidecar value: bare crc int (pre-digest
-                            # snapshots), [crc, size, sha256] (v1), or a v2
-                            # tree record — whose combined crc is
-                            # bit-identical to the serial fold, so this
-                            # quick audit needs no per-chunk work.
-                            want_crc = hashing.record_crc(want)
-                            if want_crc is not None and got != want_crc:
-                                problems[path] = (
-                                    f"crc mismatch (recorded {want_crc}, "
-                                    f"found {got})"
-                                )
-                    finally:
-                        async with cond:
-                            avail += cost
-                            cond.notify_all()
-
-                await asyncio.gather(
-                    *(check_one(p, w) for p, w in sorted(expected.items()))
+                    cost = (
+                        rec_size if rec_size is not None else budget_total // 8
+                    )
+                    nodes.append(
+                        _Node(
+                            "verify",
+                            make_check(path, want),
+                            cost_bytes=min(cost, budget_total),
+                            pool="io",
+                            path=path,
+                        )
+                    )
+                await _run_graph(
+                    nodes,
+                    budget_bytes=budget_total,
+                    owner="verify",
+                    kind="verify",
+                    caps={
+                        "io": lambda: _knobs.get_max_concurrent_io_for(
+                            storage
+                        )
+                    },
+                    priority=_Priority.BACKGROUND,
                 )
 
             event_loop.run_until_complete(check_all())
@@ -2019,104 +2154,121 @@ class Snapshot:
             return None
 
         async def scan_all() -> None:
-            # Same memory discipline as verify(): IO-concurrency cap plus a
-            # byte budget, so scrubbing 512 MB shards can't OOM a small
-            # operator VM.
-            sem = asyncio.Semaphore(knobs.get_max_concurrent_io_for(storage))
-            budget_total = get_process_memory_budget_bytes(None)
-            avail = budget_total
-            cond = asyncio.Condition()
+            # Same memory discipline as verify(), same machinery: one
+            # BACKGROUND-class engine graph of costed `verify` nodes (IO
+            # cap + byte budget, so scrubbing 512 MB shards can't OOM a
+            # small operator VM) — the scheduled bit-rot sweep yields its
+            # next admission to any serving restore or live take in this
+            # process, and its budget is ledger-audited like every other
+            # pipeline's.
+            from .engine import Node as _Node
+            from .engine import Priority as _Priority
+            from .engine import run_graph as _run_graph
 
-            async def scan_one(path: str) -> None:
-                nonlocal avail, bytes_scanned
-                want = digest_of(path)
-                rec_size = hashing.record_size(want)
-                cost = rec_size if rec_size is not None else budget_total // 8
-                cost = min(cost, budget_total)
-                async with cond:
-                    while avail < cost:
-                        await cond.wait()
-                    avail -= cost
-                try:
-                    async with sem:
-                        read_io = ReadIO(path=path)
-                        try:
-                            await storage.read(read_io)
-                        except FileNotFoundError:
-                            record(path, "missing")
-                            return
-                        except Exception as e:  # noqa: BLE001 - reported
-                            record(path, "unreadable", repr(e))
-                            return
-                        data = read_io.buf.getbuffer()
-                        sizes[path] = data.nbytes
-                        bytes_scanned += data.nbytes
-                        if want is None:
-                            record(
-                                path,
-                                "unverified",
-                                _uncovered_problem(path, unreadable_sidecars),
+            budget_total = get_process_memory_budget_bytes(None)
+
+            def make_scan(path: str, want):
+                async def scan(_ctx, _payload) -> None:
+                    nonlocal bytes_scanned
+                    read_io = ReadIO(path=path)
+                    try:
+                        await storage.read(read_io)
+                    except FileNotFoundError:
+                        record(path, "missing")
+                        return
+                    except Exception as e:  # noqa: BLE001 - reported
+                        record(path, "unreadable", repr(e))
+                        return
+                    data = read_io.buf.getbuffer()
+                    sizes[path] = data.nbytes
+                    bytes_scanned += data.nbytes
+                    if want is None:
+                        record(
+                            path,
+                            "unverified",
+                            _uncovered_problem(path, unreadable_sidecars),
+                        )
+                        return
+                    size_want = hashing.record_size(want)
+                    if size_want is not None and data.nbytes != size_want:
+                        record(
+                            path,
+                            "corrupt",
+                            f"size {data.nbytes} != recorded {size_want}",
+                        )
+                        return
+                    info = hashing.record_chunk_info(want)
+                    if info is not None:
+                        # v2 tree record: per-chunk audit attributes
+                        # corruption to the exact chunk(s), and the
+                        # repair pass can rewrite just their extents.
+                        bad = hashing.find_bad_chunks(data, want)
+                        if bad:
+                            grain = info[0]
+                            kind = (
+                                "sha256" if info[1] is not None else "crc32"
                             )
-                            return
-                        size_want = rec_size
-                        if size_want is not None and data.nbytes != size_want:
+                            corrupt_chunks[path] = bad
                             record(
                                 path,
                                 "corrupt",
-                                f"size {data.nbytes} != recorded {size_want}",
+                                f"chunk {kind} mismatch at chunk(s) "
+                                f"{bad} (grain {grain})",
                             )
                             return
-                        info = hashing.record_chunk_info(want)
-                        if info is not None:
-                            # v2 tree record: per-chunk audit attributes
-                            # corruption to the exact chunk(s), and the
-                            # repair pass can rewrite just their extents.
-                            bad = hashing.find_bad_chunks(data, want)
-                            if bad:
-                                grain = info[0]
-                                kind = (
-                                    "sha256" if info[1] is not None else "crc32"
-                                )
-                                corrupt_chunks[path] = bad
+                    else:
+                        sha_want = hashing.record_whole_sha(want)
+                        if sha_want:
+                            got = hashlib.sha256(data).hexdigest()
+                            if got != sha_want:
                                 record(
                                     path,
                                     "corrupt",
-                                    f"chunk {kind} mismatch at chunk(s) "
-                                    f"{bad} (grain {grain})",
+                                    f"sha256 {got} != recorded {sha_want}",
                                 )
                                 return
-                        else:
-                            sha_want = hashing.record_whole_sha(want)
-                            if sha_want:
-                                got = hashlib.sha256(data).hexdigest()
-                                if got != sha_want:
-                                    record(
-                                        path,
-                                        "corrupt",
-                                        f"sha256 {got} != recorded {sha_want}",
-                                    )
-                                    return
-                            crc_want = hashing.record_crc(want)
-                            got_crc = _zlib.crc32(data)
-                            if isinstance(crc_want, int) and got_crc != crc_want:
-                                record(
-                                    path,
-                                    "corrupt",
-                                    f"crc32 {got_crc} != recorded {crc_want}",
-                                )
-                                return
-                        record(path, "ok")
-                        if size_want is not None:
-                            for key in hashing.record_content_keys(want):
-                                clean_by_content.setdefault(
-                                    (size_want, key), []
-                                ).append(path)
-                finally:
-                    async with cond:
-                        avail += cost
-                        cond.notify_all()
+                        crc_want = hashing.record_crc(want)
+                        got_crc = _zlib.crc32(data)
+                        if isinstance(crc_want, int) and got_crc != crc_want:
+                            record(
+                                path,
+                                "corrupt",
+                                f"crc32 {got_crc} != recorded {crc_want}",
+                            )
+                            return
+                    record(path, "ok")
+                    if size_want is not None:
+                        for key in hashing.record_content_keys(want):
+                            clean_by_content.setdefault(
+                                (size_want, key), []
+                            ).append(path)
 
-            await asyncio.gather(*(scan_one(p) for p in locations))
+                return scan
+
+            nodes = []
+            for path in locations:
+                want = digest_of(path)
+                rec_size = hashing.record_size(want)
+                cost = rec_size if rec_size is not None else budget_total // 8
+                nodes.append(
+                    _Node(
+                        "verify",
+                        make_scan(path, want),
+                        cost_bytes=min(cost, budget_total),
+                        pool="io",
+                        path=path,
+                    )
+                )
+            await _run_graph(
+                nodes,
+                budget_bytes=budget_total,
+                owner="scrub",
+                kind="scrub",
+                caps={
+                    "io": lambda: knobs.get_max_concurrent_io_for(storage)
+                },
+                priority=_Priority.BACKGROUND,
+            )
 
         event_loop.run_until_complete(scan_all())
 
@@ -2658,24 +2810,46 @@ class Snapshot:
                         return storage, p
 
                     async def delete_wave(paths: List[str]) -> int:
-                        sem = asyncio.Semaphore(
-                            knobs.get_max_concurrent_io_for(storage)
-                        )
-                        done = 0
+                        # One BACKGROUND-class engine graph per wave: the
+                        # crash-ordered waves stay sequential (wave N+1's
+                        # graph only runs after wave N's completes), while
+                        # inside a wave deletes run capped at the IO knob —
+                        # and a retention sweep running beside a serving
+                        # restore yields its next deletions to it.
+                        from .engine import Node as _Node
+                        from .engine import Priority as _Priority
+                        from .engine import run_graph as _run_graph
 
-                        async def delete_one(p: str) -> None:
-                            nonlocal done
-                            plugin, rel = owner_of(p)
-                            async with sem:
+                        done = {"n": 0}
+
+                        def make_delete(p: str):
+                            async def delete(_ctx, _payload) -> None:
+                                plugin, rel = owner_of(p)
                                 try:
                                     await plugin.delete(rel)
-                                    done += 1
+                                    done["n"] += 1
                                 except FileNotFoundError:
-                                    done += 1  # already gone — goal reached
-                        await asyncio.gather(
-                            *(delete_one(p) for p in sorted(set(paths)))
+                                    done["n"] += 1  # already gone — goal
+                                    # reached
+
+                            return delete
+
+                        await _run_graph(
+                            [
+                                _Node("delete", make_delete(p), path=p)
+                                for p in sorted(set(paths))
+                            ],
+                            budget_bytes=0,
+                            owner="gc",
+                            kind="gc",
+                            caps={
+                                "io": lambda: (
+                                    knobs.get_max_concurrent_io_for(storage)
+                                )
+                            },
+                            priority=_Priority.BACKGROUND,
                         )
-                        return done
+                        return done["n"]
 
                     # Wave 1: condemned metadata — each snapshot atomically
                     # stops being restorable before any data byte goes.

@@ -87,6 +87,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import hashing, telemetry
 from ..io_types import ReadIO, StoragePlugin, StorageWriteStream, WriteIO
+from ..engine import qos
 from ..utils import knobs
 
 logger = logging.getLogger(__name__)
@@ -682,6 +683,10 @@ class CachedStoragePlugin(StoragePlugin):
         entry, expect = self._entry_for(path)
         if expect is None:
             return
+        # Populates are deferrable follow-on work: yield the disk write to
+        # any operation of a strictly higher QoS class before starting it
+        # (chunk-granular; the bytes are already safe in the caller's RAM).
+        await qos.pause_point()
         try:
             with telemetry.span(
                 "storage.cache_populate",
@@ -714,6 +719,7 @@ class CachedStoragePlugin(StoragePlugin):
         the path (content-addressed across snapshots), else path-keyed.
         Fail-open like every populate."""
         entry, _expect = self._entry_for(path)
+        await qos.pause_point()
         try:
             with telemetry.span(
                 "storage.cache_populate",

@@ -87,6 +87,7 @@ from .manifest import (
     Entry,
     ShardedArrayEntry,
 )
+from .engine import qos as engine_qos
 from .scheduler import (
     ReadVerificationError,
     fetch_read_io,
@@ -514,6 +515,10 @@ class _SwarmSession:
         second mismatch."""
         loop = asyncio.get_running_loop()
         extent = plan.extents[k]
+        # Chunk-granular QoS yield: an origin fetch is the swarm's unit of
+        # storage bandwidth — a strictly higher class (e.g. a foreground
+        # replica restore in this process) steals the next one.
+        await engine_qos.pause_point()
 
         async def fetch_once() -> bytes:
             read_io = await fetch_read_io(

@@ -257,16 +257,29 @@ def activate(tm: Telemetry) -> Optional[Telemetry]:
     global _active
     with _active_lock:
         prev = _active
+        # Remember the chain so out-of-LIFO closes (below) can walk past
+        # sessions that finished in the meantime.
+        tm._prev_active = prev  # type: ignore[attr-defined]
+        tm._closed = False  # type: ignore[attr-defined]
         _active = tm
         return prev
 
 
 def deactivate(tm: Telemetry, prev: Optional[Telemetry] = None) -> None:
     """Restore ``prev`` as the active session, but only if ``tm`` is still
-    the active one (a newer activation wins over a late-finishing drain)."""
+    the active one (a newer activation wins over a late-finishing drain).
+
+    Concurrent operations close out of LIFO order — a BACKGROUND drain's
+    session may finish while a FOREGROUND restore's is active, or vice
+    versa — so a closed ``prev`` must not be resurrected: restore the
+    nearest still-open session in the activation chain instead (else the
+    leaked session would silently swallow every later op's spans)."""
     global _active
     with _active_lock:
+        tm._closed = True  # type: ignore[attr-defined]
         if _active is tm:
+            while prev is not None and getattr(prev, "_closed", False):
+                prev = getattr(prev, "_prev_active", None)
             _active = prev
 
 
@@ -303,6 +316,29 @@ class PhaseTracker:
         )
         sp.dur = now - self._last
         self._last = now
+        self.spans.append(sp)
+        tm = _active
+        if tm is not None:
+            tm.add_span(name, self.cat, sp.ts, sp.dur, attrs, tid=sp.tid)
+        return sp
+
+    def note(self, name: str, dur_s: float, ts: Optional[float] = None,
+             **attrs: Any) -> Span:
+        """An out-of-band SUB-span: a duration measured inside a phase
+        (e.g. ``stage.prepare.*`` attributing ``prepare_write``'s stall)
+        recorded without moving the sequential phase boundary. It rides the
+        same spans list, so it persists in the telemetry artifact's
+        ``phase_spans``/``phases_s`` beside the phases it decomposes."""
+        self._seq += 1
+        sp = Span(
+            name=name,
+            cat=self.cat,
+            ts=ts if ts is not None else self._last - dur_s,
+            span_id=-self._seq,
+            parent_id=None,
+            attrs=attrs,
+        )
+        sp.dur = dur_s
         self.spans.append(sp)
         tm = _active
         if tm is not None:

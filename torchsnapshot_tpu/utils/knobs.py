@@ -656,6 +656,53 @@ def override_hash_workers(value: int):
     return _override_env(_ENV_HASH_WORKERS, str(value))
 
 
+_ENV_QOS = "TORCHSNAPSHOT_TPU_QOS"
+_ENV_QOS_POLL_S = "TORCHSNAPSHOT_TPU_QOS_POLL_S"
+_ENV_QOS_MAX_PAUSE_S = "TORCHSNAPSHOT_TPU_QOS_MAX_PAUSE_S"
+
+
+def is_qos_enabled() -> bool:
+    """Priority-aware admission (``engine/qos.py``): while a higher-class
+    operation (FOREGROUND > NORMAL > BACKGROUND) has registered demand in
+    this process, lower-class engines stop admitting new work — budget,
+    io/hash/transfer-pool slots, and stream chunks all yield at the next
+    admission point (chunk granularity; in-flight steps finish). Off =
+    every operation competes FIFO, the pre-engine behavior (the A/B
+    baseline ``benchmarks/qos`` measures against)."""
+    return os.environ.get(_ENV_QOS, "1") not in ("0", "false", "False")
+
+
+def get_qos_poll_s() -> float:
+    """How often a preempted (paused) engine re-checks the arbiter for
+    higher-class demand to clear (default 20 ms). The preemption-release
+    latency floor; raising it trades foreground responsiveness for fewer
+    wakeups on long pauses."""
+    val = os.environ.get(_ENV_QOS_POLL_S)
+    return float(val) if val else 0.02
+
+
+def get_qos_max_pause_s() -> float:
+    """Starvation bound: a preempted engine paused continuously for this
+    long (default 60 s) admits one round of work anyway and re-arms, so a
+    long-lived foreground class can slow background work to a trickle but
+    never wedge it (a drain must still finish, a scrub must still
+    complete). 0 disables the bound (pause as long as demand persists)."""
+    val = os.environ.get(_ENV_QOS_MAX_PAUSE_S)
+    return float(val) if val else 60.0
+
+
+def override_qos(enabled: bool):
+    return _override_env(_ENV_QOS, "1" if enabled else "0")
+
+
+def override_qos_poll_s(value: float):
+    return _override_env(_ENV_QOS_POLL_S, str(value))
+
+
+def override_qos_max_pause_s(value: float):
+    return _override_env(_ENV_QOS_MAX_PAUSE_S, str(value))
+
+
 _ENV_STAGING_THREADS = "TORCHSNAPSHOT_TPU_STAGING_THREADS"
 _ENV_MAX_CONCURRENT_IO = "TORCHSNAPSHOT_TPU_MAX_CONCURRENT_IO"
 _ENV_CONSUMING_THREADS = "TORCHSNAPSHOT_TPU_CONSUMING_THREADS"
