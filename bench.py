@@ -355,6 +355,34 @@ def _regression_gate_impl(
     return out
 
 
+def _chunk_append_hist(snapshot_path: str) -> dict:
+    """Per-chunk ``storage.<plugin>.append_s.<bucket>`` histogram summaries
+    from a local snapshot's persisted rank-0 telemetry artifact, keyed by
+    ``<plugin>.<bucket>``. Empty dict when the snapshot streamed nothing or
+    carries no artifact (fail-soft: a bench detail, never a failure)."""
+    try:
+        with open(
+            os.path.join(snapshot_path, ".telemetry", "rank_0.json"),
+            encoding="utf-8",
+        ) as f:
+            metrics = (json.load(f).get("metrics") or {})
+    except Exception:
+        return {}
+    out: dict = {}
+    for key, value in metrics.items():
+        if not key.startswith("storage.") or ".append_s." not in key:
+            continue
+        # storage.<plugin>.append_s.<bucket>.<stat>
+        head, stat = key.rsplit(".", 1)
+        plugin_bucket = head.replace("storage.", "", 1).replace(
+            ".append_s", "", 1
+        )
+        out.setdefault(plugin_bucket, {})[stat] = (
+            round(value, 6) if isinstance(value, float) else value
+        )
+    return out
+
+
 def measure_naive_save(params_slice, root: str):
     """torch.save-equivalent: blocking device_get of everything, then one
     buffered single-stream pickle write (what the reference benchmarks
@@ -654,6 +682,12 @@ def main() -> None:
                     else 1.0,
                     "stage_busy_s": round(ds.get("stage_busy_s", 0.0), 2),
                     "io_busy_s": round(ds.get("io_busy_s", 0.0), 2),
+                    # Per-chunk append-latency histogram (per plugin, size
+                    # bucketed) from the persisted artifact: attributes an
+                    # inversion to per-chunk overhead vs grain vs the disk.
+                    "chunk_append_s": _chunk_append_hist(
+                        os.path.join(root, f"ckpt_stream_{label}_{rep}")
+                    ),
                 }
             )
             log(
@@ -697,7 +731,27 @@ def main() -> None:
             },
             "all": stream_sides,
         }
+        # Merge the on-side per-rep chunk histograms: counts/sums add,
+        # extremes take min/max, percentiles keep the worst rep
+        # (conservative — bucket-exact merging isn't worth carrying here).
+        chunk_merged: dict = {}
+        for rep_rec in stream_sides["on"]:
+            for pb, stats_d in (rep_rec.get("chunk_append_s") or {}).items():
+                m = chunk_merged.setdefault(pb, {})
+                for stat, v in stats_d.items():
+                    if stat in ("count", "sum"):
+                        m[stat] = m.get(stat, 0) + v
+                    elif stat == "min":
+                        m[stat] = min(m.get(stat, v), v)
+                    else:
+                        m[stat] = max(m.get(stat, v), v)
+        for m in chunk_merged.values():
+            if m.get("count"):
+                m["mean"] = round(m.get("sum", 0.0) / m["count"], 6)
+        stream_ab["chunk_append_s"] = chunk_merged
         log(f"stream A/B medians: on={stream_ab['on']} off={stream_ab['off']}")
+        if chunk_merged:
+            log(f"stream A/B per-chunk append latency (on side): {chunk_merged}")
         # Fail-soft inversion flag: streaming exists to BEAT the whole-
         # buffer path; when ON underperforms OFF by >10% on this host (the
         # r07 artifact measured 0.21 vs 0.36 GB/s and buried it in
@@ -790,6 +844,111 @@ def main() -> None:
                 restore_record[k] = round(float(v), 4)
         log(f"full restore: {restore_record}")
 
+        # ---- flight-recorder overhead A/B + job step timeline. The
+        # recorder is always-on by default, so its cost must be provably
+        # in the noise: interleaved async takes with the recorder on vs
+        # off (same protocol as the stream A/B), compared on the drain
+        # wall median — acceptance is <=1% overhead. Then a short job-mode
+        # take sequence exercises the per-step catalog rollup end to end
+        # and runs the health detectors over it: a clean run on a healthy
+        # host must flag NOTHING (the zero-false-positive surface the
+        # continuous bench asserts at scale). Both fail-soft: diagnostics
+        # never sink the drain trajectory.
+        recorder_ab = None
+        job_timeline = None
+        try:
+            from torchsnapshot_tpu import catalog as _catalog
+            from torchsnapshot_tpu.telemetry import health as _health
+            from torchsnapshot_tpu.telemetry import recorder as _recorder
+            from torchsnapshot_tpu.telemetry import steprecord as _steprecord
+
+            rec_reps = int(os.environ.get("BENCH_RECORDER_AB_REPS", "5"))
+            rec_walls = {"on": [], "off": []}
+
+            def run_recorder_rep(rep: int, enabled: bool) -> None:
+                label = "on" if enabled else "off"
+                sub = build_stream_slice(7000 + 2 * rep + (0 if enabled else 1))
+                with _knobs.override_recorder(enabled):
+                    _recorder.reset()  # re-arm the singleton under the knob
+                    pend = Snapshot.async_take(
+                        os.path.join(root, f"ckpt_rec_{label}_{rep}"),
+                        {"model": StateDict(**sub)},
+                    )
+                    t0 = time.perf_counter()
+                    pend.wait()
+                    rec_walls[label].append(time.perf_counter() - t0)
+                shutil.rmtree(
+                    os.path.join(root, f"ckpt_rec_{label}_{rep}"),
+                    ignore_errors=True,
+                )
+
+            for rep in range(rec_reps):
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                run_recorder_rep(rep, order[0])
+                run_recorder_rep(rep, order[1])
+            _recorder.reset()  # back to the ambient knob state
+            on_med = statistics.median(rec_walls["on"])
+            off_med = statistics.median(rec_walls["off"])
+            overhead = (on_med - off_med) / off_med if off_med > 0 else 0.0
+            recorder_ab = {
+                "reps": rec_reps,
+                "on_drain_wall_s": round(on_med, 4),
+                "off_drain_wall_s": round(off_med, 4),
+                "overhead_frac": round(overhead, 4),
+                "within_budget": bool(overhead <= 0.01),
+                "on_all": [round(w, 4) for w in rec_walls["on"]],
+                "off_all": [round(w, 4) for w in rec_walls["off"]],
+            }
+            log(f"recorder A/B: {recorder_ab}")
+            if not recorder_ab["within_budget"]:
+                log(
+                    "WARNING: flight-recorder drain overhead "
+                    f"{overhead * 100:.2f}% exceeds the 1% always-on "
+                    "budget on this host"
+                )
+
+            jt_steps = int(os.environ.get("BENCH_JOB_TIMELINE_STEPS", "8"))
+            jt_bucket = os.path.join(root, "job_bucket")
+            os.makedirs(jt_bucket, exist_ok=True)
+            rngj = np.random.default_rng(7)
+            jt_frozen = {
+                f"f{i}": rngj.standard_normal(1 << 20).astype(np.float32)
+                for i in range(2)
+            }
+            jt_adapt = {"lora": rngj.standard_normal(1 << 16).astype(np.float32)}
+            for step in range(jt_steps):
+                jt_adapt["lora"] = jt_adapt["lora"] + 1.0
+                Snapshot.take(
+                    os.path.join(jt_bucket, f"step_{step:05d}"),
+                    {"m": StateDict(**jt_frozen, **jt_adapt)},
+                    job="bench-job",
+                    step=step,
+                    max_chain_len=4,
+                )
+            with _catalog.Catalog(jt_bucket) as cat:
+                jt_series = cat.load_step_telemetry(job="bench-job")
+            jt_anomalies = _health.detect_anomalies(jt_series)
+            job_timeline = {
+                "steps": jt_steps,
+                "steps_recorded": len(jt_series),
+                "summary": _steprecord.summarize_series(jt_series),
+                "anomalies": jt_anomalies,
+                "timeline": _health.render_timeline(jt_series, jt_anomalies),
+            }
+            for line in job_timeline["timeline"]:
+                log(f"  {line}")
+            if jt_anomalies:
+                log(
+                    "WARNING: health detectors flagged a clean job-mode "
+                    f"run: {sorted({a['kind'] for a in jt_anomalies})}"
+                )
+            shutil.rmtree(jt_bucket, ignore_errors=True)
+        except Exception as e:  # fail-soft by design
+            log(
+                "WARNING: recorder A/B / job-timeline leg failed "
+                f"({e!r}); recorded as absent"
+            )
+
         # ---- elastic reshard matrix (benchmarks/reshard): N→M restores
         # across mesh shapes / axis orders / replication, bit-exact, with
         # origin bytes accounted against the theoretical overlap bytes
@@ -879,6 +1038,8 @@ def main() -> None:
                         "ref_equiv_stall_s": round(ref_equiv_stall_s, 2),
                         "restore_bit_exact": ok,
                         "restore": restore_record,
+                        "recorder_ab": recorder_ab,
+                        "job_timeline": job_timeline,
                         "reshard": reshard_record,
                         "telemetry": telemetry_summary,
                         # Environment fingerprint: every TORCHSNAPSHOT_TPU_*

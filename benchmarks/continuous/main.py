@@ -29,6 +29,15 @@ shape (rebase cadence), and the bucket-bytes-vs-snapshot-count series.
 Env knobs: CONTINUOUS_BENCH_STEPS (default 60), CONTINUOUS_BENCH_KEEP_LAST
 (5), CONTINUOUS_BENCH_RETAIN_EVERY (5), CONTINUOUS_BENCH_MAX_CHAIN (8),
 CONTINUOUS_BENCH_FROZEN_MB (32), CONTINUOUS_BENCH_ADAPTER_MB (2).
+CONTINUOUS_BENCH_EXPECT_ANOMALY selects the health-detector contract:
+unset/"" asserts zero anomalies on the clean run (no false positives);
+"stall" asserts a stall_spike IS detected. CONTINUOUS_BENCH_FAULT_STEP
+(default: 3/4 through the run when EXPECT_ANOMALY=stall) picks the step
+whose take runs under CONTINUOUS_BENCH_FAULT_SPEC (a faults.py spec,
+default a 1.5s write stall) — fault-rule state lives per plugin instance
+(one per take), so an env-level spec would stall EVERY step and never
+spike against its own trailing median; the harness scopes the knob to
+the one step instead.
 The last JSON line on stdout is the machine-readable result.
 """
 
@@ -74,6 +83,8 @@ def main() -> None:
     from torchsnapshot_tpu import Snapshot, StateDict
     from torchsnapshot_tpu import catalog
     from torchsnapshot_tpu import snapshot as snapshot_mod
+    from torchsnapshot_tpu.telemetry import health, steprecord
+    from torchsnapshot_tpu.utils import knobs
 
     steps = int(os.environ.get("CONTINUOUS_BENCH_STEPS", "60"))
     keep_last = int(os.environ.get("CONTINUOUS_BENCH_KEEP_LAST", "5"))
@@ -81,6 +92,16 @@ def main() -> None:
     max_chain = int(os.environ.get("CONTINUOUS_BENCH_MAX_CHAIN", "8"))
     frozen_mb = float(os.environ.get("CONTINUOUS_BENCH_FROZEN_MB", "32"))
     adapter_mb = float(os.environ.get("CONTINUOUS_BENCH_ADAPTER_MB", "2"))
+    expect = os.environ.get("CONTINUOUS_BENCH_EXPECT_ANOMALY", "")
+    fault_step = int(
+        os.environ.get(
+            "CONTINUOUS_BENCH_FAULT_STEP",
+            str(steps * 3 // 4) if expect == "stall" else "-1",
+        )
+    )
+    fault_spec = os.environ.get(
+        "CONTINUOUS_BENCH_FAULT_SPEC", "op=write,kind=stall,secs=1.5,at=0"
+    )
 
     rng = np.random.default_rng(0)
     n_frozen = max(1, int(frozen_mb * 1e6 / (4 * 1024 * 1024)))
@@ -104,6 +125,23 @@ def main() -> None:
 
     take_walls = []
     size_series = []  # (snapshot_count_taken, bucket_bytes)
+    # Job-lifetime step-telemetry series. Retention GC deletes a condemned
+    # snapshot's step record along with it, so the catalog only ever holds
+    # the live window — the bench accumulates the full series by syncing
+    # BEFORE each retention pass (and once after the loop).
+    step_series = []
+    seen_steps = set()
+
+    def sync_step_series():
+        try:
+            with catalog.Catalog(bucket) as cat:
+                for rec in cat.load_step_telemetry(job="continuous-bench"):
+                    if rec.get("step") not in seen_steps:
+                        seen_steps.add(rec.get("step"))
+                        step_series.append(rec)
+        except Exception:  # noqa: BLE001 - telemetry is fail-open
+            pass
+
     t_begin = time.perf_counter()
     try:
         for step in range(steps):
@@ -111,18 +149,31 @@ def main() -> None:
             for k in adapters:
                 adapters[k] = adapters[k] + 1.0
             app = {"m": StateDict(**frozen, **adapters)}
+            saved_faults = os.environ.get("TORCHSNAPSHOT_TPU_FAULTS")
+            if step == fault_step:
+                os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = fault_spec
             t0 = time.perf_counter()
-            Snapshot.take(
-                os.path.join(bucket, f"step_{step:05d}"),
-                app,
-                job="continuous-bench",
-                step=step,
-                max_chain_len=max_chain,
-            )
+            try:
+                Snapshot.take(
+                    os.path.join(bucket, f"step_{step:05d}"),
+                    app,
+                    job="continuous-bench",
+                    step=step,
+                    max_chain_len=max_chain,
+                )
+            finally:
+                if step == fault_step:
+                    if saved_faults is None:
+                        os.environ.pop("TORCHSNAPSHOT_TPU_FAULTS", None)
+                    else:
+                        os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = saved_faults
             take_walls.append(time.perf_counter() - t0)
             if (step + 1) % retain_every == 0:
+                sync_step_series()
                 catalog.retain(bucket, policy, dry_run=False)
             size_series.append((step + 1, bucket_bytes(bucket)))
+        sync_step_series()
+        step_series.sort(key=lambda r: r.get("step", 0))
         sustained_s = time.perf_counter() - t_begin
         per_minute = steps / sustained_s * 60.0
 
@@ -171,6 +222,17 @@ def main() -> None:
             np.array_equal(out["m"][k], adapters[k]) for k in adapters
         ) and all(np.array_equal(out["m"][k], frozen[k]) for k in frozen)
 
+        # ---- health detectors over the job-lifetime step series.
+        anomalies = health.detect_anomalies(
+            step_series,
+            bucket_bytes=[b for _n, b in size_series],
+            window_bound=int(window_bound),
+        )
+        health.log_anomalies(anomalies)
+        timeline = health.render_timeline(step_series, anomalies)
+        for line in timeline:
+            print(line, file=sys.stderr)
+
         result = {
             "metric": "sustained_checkpoints_per_minute",
             "value": round(per_minute, 2),
@@ -193,6 +255,14 @@ def main() -> None:
                 "records_live": len(records),
                 "full_takes_live": full_takes,
                 "max_chain_seen": max_chain_seen,
+                "step_telemetry": {
+                    "expect_anomaly": expect,
+                    "fault_step": fault_step,
+                    "steps_recorded": len(step_series),
+                    "summary": steprecord.summarize_series(step_series),
+                    "anomalies": anomalies,
+                    "timeline": timeline,
+                },
                 "warm_restore": {
                     "origin_bytes": int(warm_origin),
                     "cache_bytes": int(warm_cache),
@@ -226,6 +296,26 @@ def main() -> None:
             problems.append(
                 f"recorded chain {max_chain_seen} exceeds max_chain_len "
                 f"{max_chain}"
+            )
+        telemetry_on = (
+            knobs.is_step_telemetry_enabled()
+            and knobs.is_telemetry_artifacts_enabled()
+        )
+        if telemetry_on and len(step_series) < steps:
+            problems.append(
+                f"step telemetry recorded {len(step_series)}/{steps} steps "
+                "(rollup append is dropping records)"
+            )
+        kinds = sorted({a["kind"] for a in anomalies})
+        if expect == "stall":
+            if "stall_spike" not in kinds:
+                problems.append(
+                    "expected a stall_spike anomaly (injected fault) but "
+                    f"detectors saw {kinds or 'none'}"
+                )
+        elif telemetry_on and anomalies:
+            problems.append(
+                f"false-positive anomalies on clean run: {kinds}"
             )
         result["detail"]["problems"] = problems
         print(json.dumps(result))

@@ -145,6 +145,58 @@ def render_multichip_table(src: str, rec: dict) -> str:
     return "\n".join(lines)
 
 
+def render_job_timeline(src: str, bench: dict) -> str | None:
+    """Flight-recorder overhead A/B + job step-telemetry timeline from the
+    round artifact; ``None`` when the artifact predates the leg (or it
+    failed fail-soft) so older rounds keep a valid README."""
+    d = bench["parsed"]["detail"]
+    ab = d.get("recorder_ab")
+    jt = d.get("job_timeline")
+    if not ab and not jt:
+        return None
+    lines = [
+        f"Flight recorder + per-step job telemetry (`bench.py`, regenerated "
+        f"from `{src}`; do not edit by hand, run "
+        "`python benchmarks/gen_tables.py`):",
+        "",
+    ]
+    if ab:
+        verdict = (
+            "within the 1% always-on budget"
+            if ab.get("within_budget")
+            else "OVER the 1% always-on budget on this host"
+        )
+        lines += [
+            "| Recorder A/B (async drain wall, medians) | Value |",
+            "|---|---|",
+            f"| recorder on | {ab['on_drain_wall_s']:.4f} s |",
+            f"| recorder off | {ab['off_drain_wall_s']:.4f} s |",
+            f"| overhead | **{ab['overhead_frac'] * 100:+.2f}%** ({verdict}, "
+            f"{ab['reps']} interleaved reps) |",
+        ]
+    if jt:
+        summary = jt.get("summary") or {}
+        stall = summary.get("stall_s") or {}
+        kinds = sorted({a.get("kind", "?") for a in jt.get("anomalies") or []})
+        lines += [
+            "",
+            f"Job-mode timeline (`take(job=, step=)` × {jt.get('steps')}): "
+            f"{jt.get('steps_recorded')} step records, train-loop stall "
+            f"p50 {stall.get('p50', 0.0):.3f} s / max {stall.get('max', 0.0):.3f} s, "
+            + (
+                f"health detectors flagged {kinds}"
+                if kinds
+                else "health detectors quiet (zero false positives)"
+            )
+            + ".",
+            "",
+            "```",
+            *(jt.get("timeline") or []),
+            "```",
+        ]
+    return "\n".join(lines)
+
+
 def _host_description(d: dict) -> str:
     """Where the round actually ran, from the artifact's link-probe record
     (older artifacts predate the record and were all driver runs on a real
@@ -216,6 +268,15 @@ def main() -> None:
             render_readme_bullet(src, bench),
         ),
     ]
+    jt = render_job_timeline(src, bench)
+    if jt is not None:
+        targets.append(
+            (
+                os.path.join(ROOT, "benchmarks", "README.md"),
+                "job-timeline",
+                jt,
+            )
+        )
     mc = newest_multichip()
     if mc is not None:
         targets.append(

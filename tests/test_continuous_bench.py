@@ -7,7 +7,14 @@ bounding bucket bytes while snapshot count grows, and the chain-aware warm
 restore reading ≈ only the newest delta's new bytes from origin. The
 slow-marked run — registered in pre_commit.yaml's slow lane, under the
 budget-ledger and collective-lockstep sanitizers — is the acceptance-scale
-leg: ≥ 50 sustained snapshots with a plateaued bucket."""
+leg: ≥ 50 sustained snapshots with a plateaued bucket.
+
+Every leg also exercises the per-step telemetry rollups: the bench
+accumulates the job's step series across retention GC passes, runs the
+health detectors over it, and fails itself (via ``problems``) when a clean
+run raises an anomaly or an injected fault fails to. The slow stall leg
+flips ``CONTINUOUS_BENCH_EXPECT_ANOMALY=stall`` so a ``faults.py``-injected
+write stall must trip the stall detector."""
 
 import json
 import os
@@ -68,6 +75,16 @@ def _check(result: dict) -> None:
     assert warm["bit_exact"]
     assert warm["origin_bytes"] <= warm["delta_budget_bytes"]
     assert warm["cache_bytes"] > warm["origin_bytes"]
+    # Step-telemetry rollups: one record per step survived the retention
+    # GC passes (the bench accumulates the series before each pass), and a
+    # rendered timeline with a verdict line came back in the artifact.
+    tel = d["step_telemetry"]
+    assert tel["steps_recorded"] == d["steps"], tel
+    assert tel["summary"]["steps"] == d["steps"]
+    assert tel["summary"]["bytes_written_total"] > 0
+    assert any(ln.startswith("anomalies:") for ln in tel["timeline"])
+    if not tel["expect_anomaly"]:
+        assert tel["anomalies"] == [], tel["anomalies"]
 
 
 def test_continuous_bench_smoke() -> None:
@@ -99,6 +116,11 @@ def test_continuous_bench_sustained_50_snapshots() -> None:
         extra_env={
             "TORCHSNAPSHOT_TPU_DEBUG_LEDGER": "1",
             "TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES": "1",
+            # Flight recorder explicitly on for the acceptance leg: the
+            # always-on sampler must ride 50+ steps under both sanitizers
+            # without raising a single false-positive anomaly (asserted by
+            # _check's clean-run telemetry gate).
+            "TORCHSNAPSHOT_TPU_RECORDER": "1",
         },
     )
     _check(result)
@@ -108,3 +130,31 @@ def test_continuous_bench_sustained_50_snapshots() -> None:
     # Chains rebased to full on cadence: more than one full take lives in
     # (or was pruned through) the bucket over 50+ steps at max_chain=8.
     assert d["max_chain_seen"] == d["max_chain_len"]
+
+
+@pytest.mark.slow
+def test_continuous_bench_stall_detector_fires() -> None:
+    """An injected write stall (faults.py, scoped by the bench to one step)
+    must trip the stall detector at exactly that step — the positive half
+    of the detector acceptance, paired with the clean sustained leg's
+    zero-false-positive half."""
+    result = _run_bench(
+        steps=20,
+        keep_last=4,
+        retain_every=4,
+        max_chain=4,
+        frozen_mb=8,
+        adapter_mb=1,
+        timeout=900,
+        extra_env={
+            "TORCHSNAPSHOT_TPU_DEBUG_LEDGER": "1",
+            "TORCHSNAPSHOT_TPU_DEBUG_COLLECTIVES": "1",
+            "TORCHSNAPSHOT_TPU_RECORDER": "1",
+            "CONTINUOUS_BENCH_EXPECT_ANOMALY": "stall",
+        },
+    )
+    _check(result)
+    tel = result["detail"]["step_telemetry"]
+    assert tel["fault_step"] == 15  # default: steps * 3 // 4
+    spikes = [a for a in tel["anomalies"] if a["kind"] == "stall_spike"]
+    assert any(a["step"] == tel["fault_step"] for a in spikes), tel["anomalies"]

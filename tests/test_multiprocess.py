@@ -394,6 +394,63 @@ def test_telemetry_artifacts_all_ranks(tmp_path) -> None:
     run_with_processes(_worker_telemetry_artifacts, nproc=2, args=(str(tmp_path),))
 
 
+def _worker_step_telemetry_rollup(rank: int, world_size: int, shared: str) -> None:
+    # ISSUE 16 acceptance: a 2-rank job-mode take merges BOTH ranks'
+    # telemetry artifacts into one step record (rank 0, post-commit), and
+    # the cross-rank skew in that record attributes the deliberate
+    # straggler — a rank-filtered injected write stall delays rank 1
+    # INSIDE the drain (a pre-take sleep would be absorbed by the take's
+    # opening collectives), so its pre-barrier artifact ends measurably
+    # later than rank 0's.
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import catalog as catalog_mod
+    from torchsnapshot_tpu.telemetry import health
+
+    os.environ["TORCHSNAPSHOT_TPU_FAULTS"] = (
+        "op=write,kind=stall,secs=0.6,rank=1,at=0"
+    )
+    bucket = os.path.join(shared, "bucket")
+    try:
+        for step in range(2):
+            sd = StateDict(v=np.full((256,), rank, dtype=np.float32))
+            Snapshot.take(
+                os.path.join(bucket, f"s{step}"),
+                {"per_rank": sd},
+                job="mp-job",
+                step=step,
+            )
+    finally:
+        del os.environ["TORCHSNAPSHOT_TPU_FAULTS"]
+    if rank != 0:
+        return
+    with catalog_mod.Catalog(bucket) as cat:
+        series = cat.load_step_telemetry(job="mp-job")
+    assert [r["step"] for r in series] == [0, 1], series
+    rec = series[-1]
+    assert rec["world_size"] == world_size
+    assert rec["ranks_present"] == world_size and rec["missing_ranks"] == []
+    assert rec["bytes"]["written"] > 0
+    assert rec["skew"]["straggler_rank"] == 1, rec["skew"]
+    assert rec["skew"]["end_skew_s"] > 0.3, rec["skew"]
+    # The straggler-drift detector consumes these records verbatim and
+    # attributes the anomaly to the same rank: a quiet history (skew
+    # zeroed) followed by the REAL straggler record repeating.
+    quiet = {**rec, "skew": {"end_skew_s": 0.0, "straggler_rank": None}}
+    synth = [{**quiet, "step": s} for s in range(6)] + [
+        {**rec, "step": s} for s in range(6, 9)
+    ]
+    events = health.detect_anomalies(synth)
+    assert any(
+        e["kind"] == "straggler_drift" and e.get("rank") == 1 for e in events
+    ), events
+
+
+def test_step_telemetry_merges_ranks_and_attributes_straggler(tmp_path) -> None:
+    run_with_processes(
+        _worker_step_telemetry_rollup, nproc=2, args=(str(tmp_path),)
+    )
+
+
 def _worker_divergent_collective_is_named(rank: int, world_size: int, shared: str) -> None:
     # ISSUE 11 acceptance: with the lockstep sanitizer on, an injected
     # divergent collective is detected at the next barrier on EVERY rank,

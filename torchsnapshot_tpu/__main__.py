@@ -64,6 +64,20 @@ Beyond the reference's surface (it ships no CLI). Subcommands:
         maxima, bytes, throughput, skew) — how a perf change moved the
         checkpoint, from the checkpoints themselves.
 
+    python -m torchsnapshot_tpu timeline <bucket> --job <j>
+        Job-lifetime trend view from the per-step telemetry records the
+        catalog keeps beside each ``take(job=, step=)`` commit: one row per
+        step (stall, drain wall, throughput, bytes, preemptions, skew) with
+        the health detectors' anomalies flagged in place (stall spike,
+        drain cliff, streaming inversion, straggler drift). Exit code 1
+        when any anomaly is flagged. See docs/observability.md.
+
+    python -m torchsnapshot_tpu monitor [dump.json]
+        Render a live flight-recorder dump (written continuously when
+        ``TORCHSNAPSHOT_TPU_RECORDER_DUMP`` is set): recent engine
+        occupancy/budget samples and pause/stall events of the in-flight
+        operation — introspection for a job that is still running.
+
 Works against any storage URL the library supports (local path, gs://,
 s3://).
 """
@@ -407,6 +421,96 @@ def _cmd_compare(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_timeline(args: argparse.Namespace) -> int:
+    import json
+
+    from . import catalog as catalog_mod
+    from .telemetry import health
+
+    with catalog_mod.Catalog(args.path) as cat:
+        series = cat.load_step_telemetry(job=args.job)
+    if not series:
+        print(
+            f"no step-telemetry records for job {args.job!r} under "
+            f"{args.path} (takes must pass job=/step=, with "
+            "TORCHSNAPSHOT_TPU_STEP_TELEMETRY and "
+            "TORCHSNAPSHOT_TPU_TELEMETRY_ARTIFACTS enabled)"
+        )
+        return 0
+    anomalies = health.detect_anomalies(series)
+    if args.last:
+        series = series[-args.last :]
+        shown = {r.get("step") for r in series}
+        anomalies = [a for a in anomalies if a.get("step") in shown]
+    if args.json:
+        print(
+            json.dumps(
+                {"job": args.job, "series": series, "anomalies": anomalies},
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        return 1 if anomalies else 0
+    print(f"job {args.job}: {len(series)} step(s)")
+    for line in health.render_timeline(series, anomalies):
+        print(line)
+    return 1 if anomalies else 0
+
+
+def _cmd_monitor(args: argparse.Namespace) -> int:
+    import json
+
+    from .utils import knobs
+
+    path = args.dump or knobs.get_recorder_dump_path()
+    if not path:
+        raise RuntimeError(
+            "no dump file given and TORCHSNAPSHOT_TPU_RECORDER_DUMP is "
+            "unset — point the job's recorder at a file first"
+        )
+    with open(path, encoding="utf-8") as f:
+        dump = json.load(f)
+    if args.json:
+        print(json.dumps(dump, indent=2, sort_keys=True))
+        return 0
+    samples = dump.get("samples") or []
+    import time as _time
+
+    age_s = _time.time() - dump.get("written_unix", 0.0)
+    print(
+        f"flight recorder @ {path}: pid {dump.get('pid')}, "
+        f"{len(samples)} sample(s) (capacity {dump.get('capacity')}, "
+        f"{dump.get('dropped', 0)} overwritten), written {age_s:.1f}s ago"
+    )
+    engine_samples = [s for s in samples if s.get("kind") == "engine.sample"]
+    events = [s for s in samples if s.get("kind") != "engine.sample"]
+    if engine_samples:
+        print(
+            "      ts  engine      prio  paused  admitted   GB done  "
+            "budget GB free  occupancy"
+        )
+        t_base = engine_samples[0].get("ts", 0.0)
+        for s in engine_samples[-args.last :]:
+            occ = " ".join(
+                f"{k}={v}" for k, v in (s.get("occupancy") or {}).items() if v
+            )
+            print(
+                f"{s.get('ts', 0.0) - t_base:8.2f}  {s.get('engine', '?'):<10}"
+                f"{s.get('priority', '?'):>6}  {'yes' if s.get('paused') else 'no':>6}"
+                f"{s.get('admitted', 0):>10}"
+                f"{s.get('bytes_done', 0) / 1e9:>10.2f}"
+                f"{s.get('budget_available', 0) / 1e9:>15.2f}  {occ}"
+            )
+    if events:
+        print(f"events ({len(events)}):")
+        for s in events[-args.last :]:
+            detail = {
+                k: v for k, v in s.items() if k not in ("ts", "kind")
+            }
+            print(f"  {s.get('kind')}: {detail}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m torchsnapshot_tpu",
@@ -573,6 +677,55 @@ def main(argv=None) -> int:
         "--op", choices=("take", "restore"), default="take"
     )
     p_compare.set_defaults(fn=_cmd_compare)
+
+    p_timeline = sub.add_parser(
+        "timeline",
+        help=(
+            "per-step trend table for one job from the catalog's step-"
+            "telemetry records, with health anomalies flagged "
+            "(docs/observability.md)"
+        ),
+    )
+    p_timeline.add_argument("path", help="bucket (the snapshots' parent)")
+    p_timeline.add_argument("--job", required=True, help="job id to render")
+    p_timeline.add_argument(
+        "--last",
+        type=int,
+        default=None,
+        metavar="N",
+        help="render only the last N steps (detectors still see them all)",
+    )
+    p_timeline.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable series + anomalies",
+    )
+    p_timeline.set_defaults(fn=_cmd_timeline)
+
+    p_monitor = sub.add_parser(
+        "monitor",
+        help=(
+            "render a live flight-recorder dump "
+            "(TORCHSNAPSHOT_TPU_RECORDER_DUMP) for an in-flight operation"
+        ),
+    )
+    p_monitor.add_argument(
+        "dump",
+        nargs="?",
+        default=None,
+        help="dump file (default: $TORCHSNAPSHOT_TPU_RECORDER_DUMP)",
+    )
+    p_monitor.add_argument(
+        "--last",
+        type=int,
+        default=20,
+        metavar="N",
+        help="show at most the last N samples/events (default: 20)",
+    )
+    p_monitor.add_argument(
+        "--json", action="store_true", help="print the raw dump"
+    )
+    p_monitor.set_defaults(fn=_cmd_monitor)
 
     args = parser.parse_args(argv)
     try:

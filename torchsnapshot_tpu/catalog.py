@@ -88,6 +88,11 @@ logger = logging.getLogger(__name__)
 CATALOG_DIR = ".catalog"
 RECORD_DIR = f"{CATALOG_DIR}/records"
 PIN_DIR = f"{CATALOG_DIR}/pins"
+# Per-step telemetry rollups (telemetry/steprecord.py) ride beside the
+# catalog records: same per-job grouping, same name/step object identity,
+# same lifecycle (retention GC keeps a step record exactly as long as its
+# snapshot's catalog record).
+STEP_TELEMETRY_DIR = f"{CATALOG_DIR}/telemetry"
 
 # Bump when the record layout changes incompatibly. Loaders skip records
 # with a NEWER schema (a downgraded reader must not misinterpret them) and
@@ -171,6 +176,17 @@ def record_path(job: str, name: str, step: int) -> str:
 
 def pin_path(name: str) -> str:
     return f"{PIN_DIR}/{_name_key(name)}.json"
+
+
+def step_record_path(job: str, name: str, step: int) -> str:
+    """Catalog object path of one snapshot's step-telemetry record —
+    :func:`record_path`'s layout under :data:`STEP_TELEMETRY_DIR`, so a
+    re-taken name overwrites its record and same-job listing is one prefix
+    scan."""
+    return (
+        f"{STEP_TELEMETRY_DIR}/{_slug(job)}/"
+        f"{max(0, int(step)):020d}-{_name_key(name)}.json"
+    )
 
 
 def _run(coro, loop: Optional[asyncio.AbstractEventLoop]):
@@ -321,6 +337,95 @@ class Catalog:
                 exc_info=True,
             )
             return False
+
+    def append_step_telemetry(self, record: Dict[str, Any]) -> bool:
+        """Atomically write one step-telemetry record (built by
+        ``telemetry.steprecord.build_step_record``) beside the snapshot's
+        catalog record. Fail-open like :meth:`append` — a missed record
+        loses one point of the trend line, never the commit, and the point
+        is rebuildable from the snapshot's per-rank artifacts."""
+        path = step_record_path(
+            str(record.get("job", "")),
+            str(record.get("name", "")),
+            int(record.get("step", 0)),
+        )
+        try:
+            from .telemetry import steprecord
+
+            with telemetry.span(
+                "catalog.step_append", cat="catalog", path=path
+            ):
+                self._storage.sync_write(
+                    WriteIO(
+                        path=path, buf=steprecord.dumps_step_record(record)
+                    ),
+                    self._loop,
+                )
+            telemetry.counter_add("catalog.step_appends")
+            return True
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            telemetry.counter_add("catalog.step_append_failures")
+            logger.warning(
+                "step-telemetry append for %s under %s failed (snapshot "
+                "commit unaffected; the record is rebuildable from the "
+                "snapshot's .telemetry artifacts)",
+                record.get("name"),
+                self.bucket_url,
+                exc_info=True,
+            )
+            return False
+
+    def load_step_telemetry(
+        self, job: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """All readable step-telemetry records, step order (per job),
+        de-duplicated by snapshot name — the step series the health
+        detectors and the ``timeline`` CLI run over. Unreadable or
+        newer-schema records are skipped with one warning each."""
+        from .telemetry import steprecord
+
+        prefix = (
+            STEP_TELEMETRY_DIR
+            if job is None
+            else f"{STEP_TELEMETRY_DIR}/{_slug(job)}"
+        )
+        with telemetry.span("catalog.step_scan", cat="catalog", path=prefix):
+            try:
+                paths = _run(self._storage.list_prefix(prefix), self._loop)
+            except FileNotFoundError:
+                return []
+            by_name: Dict[str, Dict[str, Any]] = {}
+            for p in sorted(paths):
+                if not p.endswith(".json"):
+                    continue
+                try:
+                    read_io = ReadIO(path=p)
+                    self._storage.sync_read(read_io, self._loop)
+                    rec = steprecord.parse_step_record(
+                        read_io.buf.getvalue()
+                    )
+                except Exception:  # noqa: BLE001 - degrade, never fail
+                    logger.warning(
+                        "unreadable step-telemetry record %s under %s "
+                        "(skipped)",
+                        p,
+                        self.bucket_url,
+                        exc_info=True,
+                    )
+                    continue
+                if job is not None and rec.get("job") != job:
+                    continue
+                key = str(rec.get("name", p))
+                prev = by_name.get(key)
+                if prev is None or (
+                    rec.get("step", 0),
+                    rec.get("created_unix", 0.0),
+                ) >= (prev.get("step", 0), prev.get("created_unix", 0.0)):
+                    by_name[key] = rec
+        return sorted(
+            by_name.values(),
+            key=lambda r: (r.get("step", 0), r.get("created_unix", 0.0)),
+        )
 
     # --------------------------------------------------------------- load
     def load(self, job: Optional[str] = None) -> List[CatalogRecord]:

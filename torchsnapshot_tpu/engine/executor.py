@@ -204,6 +204,7 @@ class GraphExecutor:
     ) -> None:
         self.budget = Budget(budget_bytes, owner=owner)
         self.rank = rank
+        self.kind = kind
         self.priority = (
             priority if priority is not None else qos_mod.current_priority()
         )
@@ -239,6 +240,12 @@ class GraphExecutor:
         # metrics) — the qos bench and the chaos harness read them.
         self.preemptions = 0
         self.preempted_wait_s = 0.0
+        # Closed QoS pause episodes as monotonic intervals; persisted with
+        # the per-op artifact so the fleet view can show pause waves.
+        self.pause_intervals: List[Interval] = []
+        # Nodes ever admitted (task-table handoffs) — an introspection
+        # rate, not an accounting quantity.
+        self.admitted = 0
 
     # ------------------------------------------------------------- building
 
@@ -285,6 +292,27 @@ class GraphExecutor:
         occ[self._ready_label] = len(self._ready)
         return occ
 
+    def introspect(self) -> Dict[str, Any]:
+        """One flight-recorder sample of this engine: identity, occupancy,
+        budget state, admission/preemption counters, and the arbiter's
+        per-class demand. Values only — safe to call from any thread at
+        any point in the engine's life (the dict is freshly built)."""
+        return {
+            "engine": self.kind,
+            "rank": self.rank,
+            "priority": self.priority.name,
+            "occupancy": self.occupancy(),
+            "bytes_done": self._bytes_done(),
+            "admitted": self.admitted,
+            "budget_total": self.budget.total,
+            "budget_available": self.budget.available,
+            "budget_hwm": self.budget.high_water_bytes,
+            "preemptions": self.preemptions,
+            "preempted_wait_s": round(self.preempted_wait_s, 6),
+            "paused": self._paused_since is not None,
+            "demand": self._arbiter.demand_snapshot(),
+        }
+
     # --------------------------------------------------------------- running
 
     async def run(
@@ -313,7 +341,9 @@ class GraphExecutor:
                     if self.all_done():
                         break
                     # Work exists but is gated (preemption pause): poll for
-                    # the higher class's demand to clear.
+                    # the higher class's demand to clear. Keep sampling —
+                    # pause waves are exactly what the recorder is for.
+                    telemetry.recorder.sample_engine(self)
                     await asyncio.sleep(knobs.get_qos_poll_s())
                     continue
                 done, _ = await asyncio.wait(
@@ -329,6 +359,7 @@ class GraphExecutor:
                 self.reporter.maybe_report(
                     self.occupancy(), self._bytes_done(), self.budget
                 )
+                telemetry.recorder.sample_engine(self)
         finally:
             self._arbiter.unregister(self.priority)
             self._note_resumed()
@@ -355,6 +386,15 @@ class GraphExecutor:
             self._paused_since = now
             self.preemptions += 1
             telemetry.counter_add("engine.preemptions")
+            telemetry.recorder.record_event(
+                "engine.pause",
+                {
+                    "engine": self.kind,
+                    "rank": self.rank,
+                    "priority": self.priority.name,
+                    "demand": self._arbiter.demand_snapshot(),
+                },
+            )
             return True
         max_pause = knobs.get_qos_max_pause_s()
         if max_pause > 0 and now - self._paused_since >= max_pause:
@@ -365,9 +405,21 @@ class GraphExecutor:
 
     def _note_resumed(self) -> None:
         if self._paused_since is not None:
-            waited = time.monotonic() - self._paused_since
+            now = time.monotonic()
+            waited = now - self._paused_since
+            self.pause_intervals.append((self._paused_since, now))
             self.preempted_wait_s += waited
             telemetry.counter_add("engine.preempted_wait_s", waited)
+            telemetry.histogram_observe("engine.pause_s", waited)
+            telemetry.recorder.record_event(
+                "engine.resume",
+                {
+                    "engine": self.kind,
+                    "rank": self.rank,
+                    "priority": self.priority.name,
+                    "paused_s": round(waited, 6),
+                },
+            )
             self._paused_since = None
 
     def _dispatch(self) -> None:
@@ -422,6 +474,7 @@ class GraphExecutor:
         self._tasks[task] = node
         self._inflight[node.pool] += 1
         self._t0[node] = time.monotonic()
+        self.admitted += 1
 
     async def _run_node(self, node: Node, payload: Any) -> Any:
         # `started` marks whether the body ever ran: an abort that cancels
@@ -515,13 +568,34 @@ class GraphExecutor:
         poll = knobs.get_qos_poll_s()
         self.preemptions += 1
         telemetry.counter_add("engine.preemptions")
+        telemetry.recorder.record_event(
+            "engine.pause",
+            {
+                "engine": self.kind,
+                "rank": self.rank,
+                "priority": self.priority.name,
+                "demand": self._arbiter.demand_snapshot(),
+            },
+        )
         while self._arbiter.preempted(self.priority):
             if max_pause > 0 and time.monotonic() - t0 >= max_pause:
                 break
             await asyncio.sleep(poll)
-        waited = time.monotonic() - t0
+        t1 = time.monotonic()
+        waited = t1 - t0
+        self.pause_intervals.append((t0, t1))
         self.preempted_wait_s += waited
         telemetry.counter_add("engine.preempted_wait_s", waited)
+        telemetry.histogram_observe("engine.pause_s", waited)
+        telemetry.recorder.record_event(
+            "engine.resume",
+            {
+                "engine": self.kind,
+                "rank": self.rank,
+                "priority": self.priority.name,
+                "paused_s": round(waited, 6),
+            },
+        )
 
     # ---------------------------------------------------------------- aborts
 
@@ -568,14 +642,24 @@ class GraphExecutor:
         warn_s = knobs.get_stall_warn_s()
         if warn_s <= 0:
             return None
+        def on_fire() -> None:
+            telemetry.counter_add("scheduler.stall_warnings", 1)
+            telemetry.recorder.record_event(
+                "engine.stall_warning",
+                {
+                    "engine": self.kind,
+                    "rank": self.rank,
+                    "occupancy": self.occupancy(),
+                    "bytes_done": self._bytes_done(),
+                },
+            )
+
         watchdog = telemetry.StallWatchdog(
             self._progress,
             warn_s,
             occupancy=self.occupancy,
             rank=self.rank,
-            on_fire=lambda: telemetry.counter_add(
-                "scheduler.stall_warnings", 1
-            ),
+            on_fire=on_fire,
         )
         return asyncio.ensure_future(watchdog.run())
 

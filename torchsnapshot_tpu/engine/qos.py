@@ -102,6 +102,18 @@ class QoSArbiter:
         with self._lock:
             return {p.name: n for p, n in self._demand.items()}
 
+    def introspect(self) -> dict:
+        """One flight-recorder sample of the arbiter: per-class demand plus
+        which classes are currently preempted by it."""
+        demand = self.demand_snapshot()
+        return {
+            "demand": demand,
+            "qos_enabled": knobs.is_qos_enabled(),
+            "preempted_classes": [
+                p.name for p in Priority if self.preempted(p)
+            ],
+        }
+
 
 _ARBITER = QoSArbiter()
 
@@ -171,10 +183,21 @@ async def pause_point(
     max_pause = knobs.get_qos_max_pause_s()
     poll = knobs.get_qos_poll_s()
     telemetry.counter_add("engine.preemptions")
+    telemetry.recorder.record_event(
+        "engine.pause",
+        {"engine": "pause_point", "priority": p.name,
+         "demand": arb.demand_snapshot()},
+    )
     while arb.preempted(p):
         if max_pause > 0 and time.monotonic() - t0 >= max_pause:
             break
         await asyncio.sleep(poll)
     waited = time.monotonic() - t0
     telemetry.counter_add("engine.preempted_wait_s", waited)
+    telemetry.histogram_observe("engine.pause_s", waited)
+    telemetry.recorder.record_event(
+        "engine.resume",
+        {"engine": "pause_point", "priority": p.name,
+         "paused_s": round(waited, 6)},
+    )
     return waited

@@ -111,6 +111,29 @@ _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 
 
+def _storage_label(storage) -> str:
+    """Short plugin label for per-plugin metric names: ``FSStoragePlugin``
+    → ``fs``, ``CachedStoragePlugin`` → ``cached`` — matching the names the
+    plugins themselves use in ``storage.<plugin>.write_bytes``."""
+    name = type(storage).__name__
+    if name.endswith("StoragePlugin"):
+        name = name[: -len("StoragePlugin")]
+    return name.lower() or "unknown"
+
+
+def _chunk_size_bucket(nbytes: int) -> str:
+    """Size bucket for per-chunk append-latency histograms. Four buckets
+    keyed to where streaming overheads live: per-call overhead dominates
+    ≤1M, grain effects the middle, device/disk bandwidth >64M."""
+    if nbytes <= 1 << 20:
+        return "le1m"
+    if nbytes <= 8 << 20:
+        return "le8m"
+    if nbytes <= 64 << 20:
+        return "le64m"
+    return "gt64m"
+
+
 def derive_local_world_size(coordinator=None) -> int:
     """Ranks co-hosted with this process (sharing one disk/NIC).
 
@@ -550,6 +573,7 @@ class _WritePipeline:
             )
         queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, inflight))
         _END = object()
+        storage_label = _storage_label(self.storage)
         try:
             stream = await self.storage.write_stream(req.path)
         except BaseException:
@@ -615,6 +639,14 @@ class _WritePipeline:
                 t0 = time.monotonic()
                 await stream.append(buf)
                 ctx.record_interval("io", t0, req.path, nbytes)
+                if self._tm is not None:
+                    # Per-chunk append latency by plugin and size bucket —
+                    # the data that attributes a streaming inversion to
+                    # per-chunk overhead vs grain vs the storage device.
+                    self._tm.metrics.histogram(
+                        f"storage.{storage_label}.append_s."
+                        f"{_chunk_size_bucket(nbytes)}"
+                    ).observe(time.monotonic() - t0)
                 total += nbytes
                 self.progress.note_written(nbytes)
                 if not holds_full:
@@ -1108,6 +1140,14 @@ class PendingIOWork:
             "stage_substreams": {
                 kind: _merge_intervals(ivs)
                 for kind, ivs in p._staging_ctx.times.intervals().items()
+            },
+            # Engine/QoS introspection totals + closed pause episodes, so
+            # preemption waves survive into the persisted artifact instead
+            # of existing only as live metrics.
+            "engine": {
+                "preemptions": p._engine.preemptions,
+                "preempted_wait_s": round(p._engine.preempted_wait_s, 6),
+                "pause_intervals": list(p._engine.pause_intervals),
             },
         }
 

@@ -1075,10 +1075,77 @@ class Snapshot:
             )
             with catalog_mod.Catalog(bucket, event_loop=event_loop) as cat:
                 cat.append(record)
+                cls._append_step_telemetry_record(
+                    cat,
+                    storage,
+                    event_loop,
+                    world_size,
+                    job=job,
+                    step=int(step),
+                    name=name,
+                    base=base_field,
+                    chain_len=chain_len,
+                )
         except Exception:  # noqa: BLE001 - fail-open by contract
             logger.warning(
                 "catalog record for %s could not be appended (snapshot "
                 "commit unaffected)", path, exc_info=True,
+            )
+
+    @classmethod
+    def _append_step_telemetry_record(
+        cls,
+        cat: "Any",
+        storage: StoragePlugin,
+        event_loop: asyncio.AbstractEventLoop,
+        world_size: int,
+        *,
+        job: str,
+        step: int,
+        name: str,
+        base: Optional[str],
+        chain_len: int,
+    ) -> None:
+        """Rank 0's commit-time step-telemetry rollup: merge the per-rank
+        artifacts every rank persisted before the commit barrier (so they
+        are all readable here) and append the compact step record beside
+        the catalog record. Fail-open on its own — a telemetry problem
+        must not take down the catalog append it rides with, and the
+        record is rebuildable from the artifacts while the snapshot
+        lives."""
+        if not knobs.is_step_telemetry_enabled():
+            return
+        if not knobs.is_telemetry_artifacts_enabled():
+            return  # no artifacts → nothing to roll up
+        try:
+            artifacts, problems = telemetry.aggregate.read_artifacts(
+                storage, event_loop, world_size, op="take"
+            )
+            if not artifacts:
+                logger.warning(
+                    "no telemetry artifacts readable for %s "
+                    "(problems: %s); step-telemetry record skipped",
+                    name,
+                    problems,
+                )
+                return
+            agg = telemetry.aggregate.aggregate(artifacts, world_size)
+            record = telemetry.steprecord.build_step_record(
+                job,
+                step,
+                name,
+                agg,
+                artifacts,
+                base=base,
+                chain_len=chain_len,
+            )
+            cat.append_step_telemetry(record)
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "step-telemetry record for %s could not be appended "
+                "(snapshot commit and catalog record unaffected)",
+                name,
+                exc_info=True,
             )
 
     @classmethod
@@ -2605,7 +2672,15 @@ class Snapshot:
                         except Exception:  # noqa: BLE001 - unclassifiable
                             catalog_keep.add(p)
                             continue
-                        if p.startswith(f"{catalog_mod.RECORD_DIR}/"):
+                        if p.startswith(
+                            (
+                                f"{catalog_mod.RECORD_DIR}/",
+                                f"{catalog_mod.STEP_TELEMETRY_DIR}/",
+                            )
+                        ):
+                            # Step-telemetry rollups share their snapshot's
+                            # lifecycle: kept with a retained root, deleted
+                            # in the record wave with a condemned one.
                             record_paths.setdefault(name, []).append(p)
                         elif p.startswith(f"{catalog_mod.PIN_DIR}/"):
                             pinned.add(name)

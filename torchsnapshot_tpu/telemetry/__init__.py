@@ -58,11 +58,14 @@ from .export import (
 )
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .progress import ProgressTracker, StallWatchdog
-from . import aggregate, artifact
+from . import aggregate, artifact, health, recorder, steprecord
 
 __all__ = [
     "aggregate",
     "artifact",
+    "health",
+    "recorder",
+    "steprecord",
     "ProgressTracker",
     "StallWatchdog",
     "Telemetry",

@@ -153,6 +153,16 @@ def aggregate(
         written = nbytes.get("written", nbytes.get("staged", 0)) or 0
         wall = stats.get("wall_s", 0.0)
         start, end = _rank_window(a)
+        # Engine/QoS section (artifacts since the flight-recorder PR); fall
+        # back to the live metric counters older artifacts carry.
+        eng = a.get("engine") or {}
+        metrics = a.get("metrics") or {}
+        preemptions = eng.get(
+            "preemptions", metrics.get("engine.preemptions", 0)
+        ) or 0
+        preempted_wait_s = eng.get(
+            "preempted_wait_s", metrics.get("engine.preempted_wait_s", 0.0)
+        ) or 0.0
         per_rank[r] = {
             "op": a.get("op"),
             "hostname": a.get("hostname"),
@@ -168,6 +178,9 @@ def aggregate(
             "spans_dropped": a.get("spans_dropped", 0) or 0,
             "start_unix": start,
             "end_unix": end,
+            "preemptions": preemptions,
+            "preempted_wait_s": round(preempted_wait_s, 6),
+            "pause_intervals": list(eng.get("pause_intervals") or ()),
         }
         if start is not None:
             starts[r] = start
@@ -226,6 +239,12 @@ def aggregate(
         },
         "storage_bytes": storage_bytes,
         "spans_dropped": sum(p["spans_dropped"] for p in per_rank.values()),
+        "qos": {
+            "preemptions": sum(p["preemptions"] for p in per_rank.values()),
+            "preempted_wait_s": round(
+                sum(p["preempted_wait_s"] for p in per_rank.values()), 6
+            ),
+        },
     }
 
 
@@ -264,6 +283,17 @@ def format_stats(agg: Dict[str, Any]) -> List[str]:
         lines.append(
             f"straggler: rank {agg['skew']['straggler_rank']} "
             f"(end skew {agg['skew']['end_skew_s']:.3f}s across ranks)"
+        )
+    qos = agg.get("qos") or {}
+    if qos.get("preemptions"):
+        waves = sum(
+            len(p.get("pause_intervals") or ())
+            for p in agg["per_rank"].values()
+        )
+        lines.append(
+            f"qos: {qos['preemptions']} preemptions, "
+            f"{qos['preempted_wait_s']:.3f}s paused across ranks "
+            f"({waves} pause waves)"
         )
     if agg["storage_bytes"]:
         lines.append("storage:")

@@ -125,6 +125,20 @@ def build_artifact(
             artifact["intervals"][f"stage_{kind}"] = _round_intervals(
                 ivs, offset
             )
+        # Engine/QoS introspection (additive, v1-compatible): preemption
+        # totals and closed pause episodes, wall-clock-stamped like every
+        # other interval stream.
+        eng = io_summary.get("engine")
+        if eng is not None:
+            artifact["engine"] = {
+                "preemptions": eng.get("preemptions", 0) or 0,
+                "preempted_wait_s": round(
+                    eng.get("preempted_wait_s", 0.0) or 0.0, 6
+                ),
+                "pause_intervals": _round_intervals(
+                    eng.get("pause_intervals") or (), offset
+                ),
+            }
     if tm is not None:
         artifact["metrics"] = tm.metrics.as_dict()
         artifact["spans_dropped"] = tm.buffer.dropped

@@ -1,0 +1,204 @@
+"""Per-step telemetry rollups for job-mode checkpointing.
+
+Each ``take(job=, step=)`` commit appends ONE compact, schema-versioned
+step-telemetry record beside the catalog record (``catalog.py`` owns the
+paths and storage IO; ``snapshot.py`` hooks the commit). The record is a
+pure derivation of the per-rank artifacts every rank persisted before the
+commit barrier — rank 0 merges them through ``aggregate.aggregate`` and
+keeps only the scalars a trend line needs: step stall, drain wall,
+phase-duration spread, bytes written/deduped, cache/preemption counters,
+and cross-rank skew. Losing one (fail-open, like the artifacts themselves)
+loses nothing permanent: it can be rebuilt from the snapshot's
+``.telemetry/rank_<k>.json`` files as long as the snapshot lives.
+
+The step series is the substrate the health detectors (``health.py``) and
+the ``timeline`` CLI run over: KB-sized records, one list() per job, no
+need to touch any snapshot's tree.
+
+Module-level imports are stdlib-only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+STEP_SCHEMA_VERSION = 1
+
+# Metric counters worth trending step over step, summed across ranks.
+# Missing ones (metric never incremented, telemetry session absent on a
+# rank) simply stay 0 — the detectors treat 0 as "quiet", not "broken".
+_COUNTER_METRICS = {
+    "preemptions": "engine.preemptions",
+    "preempted_wait_s": "engine.preempted_wait_s",
+    "stall_warnings": "scheduler.stall_warnings",
+    "stream_chunks": "scheduler.stream_chunks",
+    "cache_hits": "cache.hits",
+    "cache_misses": "cache.misses",
+}
+
+
+def _sum_metric(artifacts: Dict[int, Dict[str, Any]], key: str) -> float:
+    total = 0.0
+    for a in artifacts.values():
+        v = (a.get("metrics") or {}).get(key)
+        if isinstance(v, (int, float)):
+            total += v
+    return total
+
+
+def build_step_record(
+    job: str,
+    step: int,
+    name: str,
+    agg: Dict[str, Any],
+    artifacts: Dict[int, Dict[str, Any]],
+    base: Optional[str] = None,
+    chain_len: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Roll one step's per-rank artifacts (already merged into ``agg`` by
+    :func:`aggregate.aggregate`) into the compact step record."""
+    per_rank = agg.get("per_rank") or {}
+
+    # Step stall: the wall time this step held the training loop. For an
+    # async_take the phases are exactly the synchronous planning/staging
+    # slice before control returns (the drain overlaps training); for a
+    # sync op the drain blocks the loop too, so a rank's stall is its
+    # phase total plus its drain wall. Max over ranks either way — the
+    # loop resumes when the slowest rank does.
+    is_async = agg.get("op") == "async_take"
+    stall_s = 0.0
+    for rank, p in per_rank.items():
+        rank_stall = sum((p.get("phases_s") or {}).values())
+        if not is_async:
+            art = artifacts.get(rank) or {}
+            rank_stall += (
+                (art.get("drain_stats_s") or {}).get("wall_s", 0.0) or 0.0
+            )
+        stall_s = max(stall_s, rank_stall)
+
+    drain_wall_s = 0.0
+    for a in artifacts.values():
+        drain_wall_s = max(
+            drain_wall_s, (a.get("drain_stats_s") or {}).get("wall_s", 0.0)
+        )
+
+    totals = agg.get("totals") or {}
+    bytes_written = totals.get("bytes_written", 0) or 0
+    bytes_deduped = sum(p.get("bytes_deduped", 0) or 0 for p in per_rank.values())
+
+    counters = {
+        out: round(_sum_metric(artifacts, key), 6)
+        for out, key in _COUNTER_METRICS.items()
+    }
+
+    skew_in = agg.get("skew") or {}
+    skew = {}
+    if skew_in:
+        skew = {
+            "end_skew_s": skew_in.get("end_skew_s", 0.0),
+            "straggler_rank": skew_in.get("straggler_rank"),
+        }
+
+    phases = {
+        pname: {
+            "mean": round(rec.get("mean", 0.0), 6),
+            "max": round(rec.get("max", 0.0), 6),
+            "max_rank": rec.get("max_rank"),
+        }
+        for pname, rec in (agg.get("phases_s") or {}).items()
+    }
+
+    return {
+        "schema_version": STEP_SCHEMA_VERSION,
+        "job": job,
+        "step": int(step),
+        "name": name,
+        "base": base,
+        "chain_len": chain_len,
+        "created_unix": round(time.time(), 6),
+        "op": agg.get("op"),
+        "world_size": agg.get("world_size"),
+        "ranks_present": len(agg.get("ranks") or ()),
+        "missing_ranks": list(agg.get("missing_ranks") or ()),
+        "wall_s": round(totals.get("wall_s", 0.0) or 0.0, 6),
+        "stall_s": round(stall_s, 6),
+        "drain_wall_s": round(drain_wall_s, 6),
+        "drain_gbps": round(bytes_written / 1e9 / drain_wall_s, 6)
+        if drain_wall_s > 0
+        else 0.0,
+        "phases_s": phases,
+        "bytes": {"written": bytes_written, "deduped": bytes_deduped},
+        "counters": counters,
+        "skew": skew,
+        "spans_dropped": agg.get("spans_dropped", 0) or 0,
+    }
+
+
+def dumps_step_record(record: Dict[str, Any]) -> bytes:
+    return json.dumps(record, sort_keys=True).encode("utf-8")
+
+
+def parse_step_record(data: bytes) -> Dict[str, Any]:
+    """Decode + validate one step record; ``ValueError`` on anything that
+    isn't one this library understands — callers degrade per record."""
+    try:
+        parsed = json.loads(bytes(data).decode("utf-8"))
+    except Exception as e:
+        raise ValueError(f"unparseable step-telemetry record: {e!r}") from e
+    if not isinstance(parsed, dict):
+        raise ValueError(
+            f"step-telemetry record is not a JSON object: {type(parsed).__name__}"
+        )
+    version = parsed.get("schema_version")
+    if not isinstance(version, int):
+        raise ValueError("step-telemetry record has no integer schema_version")
+    if version > STEP_SCHEMA_VERSION:
+        raise ValueError(
+            f"step-telemetry record schema v{version} is newer than this "
+            f"library understands (v{STEP_SCHEMA_VERSION})"
+        )
+    if "job" not in parsed or "step" not in parsed:
+        raise ValueError("step-telemetry record missing job/step")
+    return parsed
+
+
+def summarize_series(series: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Scalar summary of a step series for bench artifacts / CLI headers."""
+    recs: List[Dict[str, Any]] = sorted(series, key=lambda r: r.get("step", 0))
+    if not recs:
+        return {"steps": 0}
+
+    def vals(key: str) -> List[float]:
+        out = []
+        for r in recs:
+            v = r.get(key)
+            if isinstance(v, (int, float)):
+                out.append(float(v))
+        return out
+
+    def stats(xs: List[float]) -> Dict[str, float]:
+        if not xs:
+            return {"mean": 0.0, "max": 0.0}
+        s = sorted(xs)
+        return {
+            "mean": round(sum(xs) / len(xs), 6),
+            "p50": round(s[len(s) // 2], 6),
+            "max": round(max(xs), 6),
+        }
+
+    return {
+        "steps": len(recs),
+        "first_step": recs[0].get("step"),
+        "last_step": recs[-1].get("step"),
+        "stall_s": stats(vals("stall_s")),
+        "drain_wall_s": stats(vals("drain_wall_s")),
+        "drain_gbps": stats(vals("drain_gbps")),
+        "bytes_written_total": sum(
+            (r.get("bytes") or {}).get("written", 0) or 0 for r in recs
+        ),
+        "preemptions_total": sum(
+            (r.get("counters") or {}).get("preemptions", 0) or 0 for r in recs
+        ),
+    }

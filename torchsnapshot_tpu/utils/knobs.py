@@ -356,6 +356,97 @@ def override_stall_warn_s(value: float):
     return _override_env(_ENV_STALL_WARN_S, str(value))
 
 
+_ENV_RECORDER = "TORCHSNAPSHOT_TPU_RECORDER"
+_ENV_RECORDER_CAPACITY = "TORCHSNAPSHOT_TPU_RECORDER_CAPACITY"
+_ENV_RECORDER_INTERVAL_S = "TORCHSNAPSHOT_TPU_RECORDER_INTERVAL_S"
+_ENV_RECORDER_DUMP = "TORCHSNAPSHOT_TPU_RECORDER_DUMP"
+_ENV_STEP_TELEMETRY = "TORCHSNAPSHOT_TPU_STEP_TELEMETRY"
+
+_DEFAULT_RECORDER_CAPACITY = 4096
+_DEFAULT_RECORDER_INTERVAL_S = 0.25
+
+
+def is_recorder_enabled() -> bool:
+    """The job-lifetime flight recorder (``telemetry/recorder.py``): a
+    process-wide, bounded ring-buffer time-series sampler fed by the
+    dataflow engine's introspection surface (pool occupancy, budget
+    high-water, per-class QoS demand, preemption/pause waves, stall-watchdog
+    firings). On by default — the ring is a few MB at the default capacity
+    and sampling is one time-check per engine wait round; ``0`` disables it
+    entirely, restoring a zero-allocation no-op at every feed site."""
+    return os.environ.get(_ENV_RECORDER, "1") not in ("0", "false", "False")
+
+
+def get_recorder_capacity() -> int:
+    """Ring capacity of the flight recorder, in samples (default 4096).
+    When full, the oldest samples are overwritten; ``dropped`` counts the
+    overwrites so truncation is never silent."""
+    return max(16, _get_int(_ENV_RECORDER_CAPACITY, _DEFAULT_RECORDER_CAPACITY))
+
+
+def get_recorder_interval_s() -> float:
+    """Minimum spacing between two engine samples in the flight recorder
+    (default 0.25 s). Discrete events (pause/resume waves, stall-watchdog
+    firings) are always recorded regardless of this rate limit."""
+    try:
+        return max(
+            0.0,
+            float(
+                os.environ.get(
+                    _ENV_RECORDER_INTERVAL_S, _DEFAULT_RECORDER_INTERVAL_S
+                )
+            ),
+        )
+    except ValueError:
+        return _DEFAULT_RECORDER_INTERVAL_S
+
+
+def get_recorder_dump_path() -> Optional[str]:
+    """Local file the flight recorder periodically mirrors its ring to
+    (atomic replace, at most ~1/s), so ``python -m torchsnapshot_tpu
+    monitor`` can render an in-flight operation from another process.
+    Unset (the default) disables the mirror — the ring then lives only in
+    process memory."""
+    return os.environ.get(_ENV_RECORDER_DUMP) or None
+
+
+def is_step_telemetry_enabled() -> bool:
+    """Per-step telemetry rollups for catalog-managed takes: each
+    ``take(job=, step=)`` commit appends a compact schema-versioned record
+    under ``<bucket>/.catalog/telemetry/`` (rank 0, fail-open) summarizing
+    the step — stall, drain wall, phase durations, bytes written/deduped,
+    preemption counters, cross-rank skew — merged from the per-rank
+    ``.telemetry/`` artifacts. The job-lifetime series behind
+    ``python -m torchsnapshot_tpu timeline`` and the health detectors.
+    Requires ``TORCHSNAPSHOT_TPU_TELEMETRY_ARTIFACTS`` (the per-rank
+    source data); ``0`` disables the rollup append only."""
+    return os.environ.get(_ENV_STEP_TELEMETRY, "1") not in (
+        "0",
+        "false",
+        "False",
+    )
+
+
+def override_recorder(enabled: bool):
+    return _override_env(_ENV_RECORDER, "1" if enabled else "0")
+
+
+def override_recorder_capacity(value: int):
+    return _override_env(_ENV_RECORDER_CAPACITY, str(value))
+
+
+def override_recorder_interval_s(value: float):
+    return _override_env(_ENV_RECORDER_INTERVAL_S, str(value))
+
+
+def override_recorder_dump_path(path: str):
+    return _override_env(_ENV_RECORDER_DUMP, path)
+
+
+def override_step_telemetry(enabled: bool):
+    return _override_env(_ENV_STEP_TELEMETRY, "1" if enabled else "0")
+
+
 def env_fingerprint() -> dict:
     """Every ``TORCHSNAPSHOT_TPU_*`` env var currently set, verbatim — the
     knob half of the persisted artifact's environment fingerprint. Reading
