@@ -1,6 +1,6 @@
 """Checkpoint-invariant static analyzer (the ``dev/lint.py`` analysis gate).
 
-Nine AST passes over the library, zero third-party dependencies:
+Ten AST passes over the library, zero third-party dependencies:
 
 1. async-safety (TSA1xx) — no blocking calls on the event loop;
 2. task-leak (TSA2xx) — every spawned task AND executor future retained
@@ -19,9 +19,16 @@ Nine AST passes over the library, zero third-party dependencies:
    SPMD-pure: no collective behind rank/time/filesystem/exception-derived
    branches, none in except/finally handlers, none per-iteration of
    divergent loops, and plan-affecting functions read only
-   manifest/knob/entry state.
+   manifest/knob/entry state;
+10. durability-discipline (TSA10xx) — flow-sensitive crash consistency:
+    durable writes go through an atomic-commit idiom, catalog publishes
+    are dominated by the data commit, GC deletes are keep-set gated, and
+    every commit-point function stays pinned to a ``faults.py``
+    kill-point op class.
 
-Run: ``python -m dev.analyze`` (or via ``python dev/lint.py``).
+Run: ``python -m dev.analyze`` (``--jobs N`` fans per-file passes out to
+worker processes; ``--timings`` prints a per-pass wall-time report), or
+via ``python dev/lint.py``.
 See ``docs/static-analysis.md`` for codes, suppression, and the baseline
 workflow.
 """

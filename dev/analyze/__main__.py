@@ -47,6 +47,21 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-passes", action="store_true", help="list passes and exit"
     )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        metavar="N",
+        help=(
+            "fan file-scoped passes out over N worker processes "
+            "(0 = cpu count; repo-scoped passes always run in the parent)"
+        ),
+    )
+    parser.add_argument(
+        "--timings",
+        action="store_true",
+        help="print a per-pass wall-time report after the run",
+    )
     args = parser.parse_args(argv)
 
     if args.list_passes:
@@ -61,7 +76,14 @@ def main(argv=None) -> int:
         ctx.lib_files = sorted(
             os.path.relpath(os.path.abspath(f), args.root) for f in args.files
         )
-    findings = run_passes(ctx)
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
+    timings = {} if args.timings else None
+    findings = run_passes(ctx, jobs=jobs, timings=timings)
+    if timings is not None:
+        print("per-pass wall time (summed across workers):")
+        for name, dt in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:<24} {dt * 1000:8.1f} ms")
+        print(f"  {'total':<24} {sum(timings.values()) * 1000:8.1f} ms")
 
     if args.update_baseline:
         write_baseline(args.baseline, findings)

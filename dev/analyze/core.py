@@ -24,6 +24,7 @@ import ast
 import json
 import os
 import re
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -444,6 +445,7 @@ def get_passes():
     from . import (
         async_safety,
         collective_discipline,
+        durability_discipline,
         fault_coverage,
         knob_drift,
         manifest_schema,
@@ -463,16 +465,117 @@ def get_passes():
         ("thread-safety", thread_safety.run),
         ("fault-coverage", fault_coverage.run),
         ("collective-discipline", collective_discipline.run),
+        ("durability-discipline", durability_discipline.run),
     ]
 
 
-def run_passes(ctx: AnalysisContext) -> List[Finding]:
-    """All passes over ``ctx``, inline-noqa already applied (markdown
-    findings have no noqa mechanism — use the baseline)."""
+# Sharding scope for ``--jobs``: a "file" pass derives each finding from one
+# lib file in isolation (the catalogs it consults are read-only inputs), so
+# it is safe to fan out over disjoint file shards. A "repo" pass does
+# cross-file or registry/contract analysis (knob drift emits once per
+# registry entry, fault coverage and TSA1004 walk the whole commit-point
+# inventory) and must run exactly once, on the full context, in the parent.
+PASS_SCOPES: Dict[str, str] = {
+    "async-safety": "file",
+    "task-leak": "file",
+    "knob-drift": "repo",
+    "telemetry-discipline": "file",
+    "manifest-schema": "repo",
+    "resource-balance": "file",
+    "thread-safety": "file",
+    "fault-coverage": "repo",
+    "collective-discipline": "file",
+    "durability-discipline": "repo",
+}
+
+
+def _context_spec(ctx: AnalysisContext) -> Dict:
+    """Picklable constructor kwargs (minus ``lib_files``) for rebuilding an
+    equivalent context inside a ``--jobs`` worker process."""
+    return {
+        "root": ctx.root,
+        "knobs_path": ctx.knobs_path,
+        "catalog_path": ctx.catalog_path,
+        "doc_files": ctx.doc_files,
+        "telemetry_catalog_path": ctx.telemetry_catalog_path,
+        "telemetry_exempt_prefixes": ctx.telemetry_exempt_prefixes,
+        "manifest_path": ctx.manifest_path,
+        "io_types_path": ctx.io_types_path,
+        "faults_path": ctx.faults_path,
+    }
+
+
+def _run_file_shard(spec: Dict, shard: List[str]):
+    """Worker entry point: every file-scoped pass over one shard of lib
+    files. Returns (findings, per-pass wall seconds); parse failures ride
+    along as findings so the parent needn't re-parse broken files."""
+    ctx = AnalysisContext(lib_files=shard, **spec)
     findings: List[Finding] = []
-    for _, run in get_passes():
+    timings: Dict[str, float] = {}
+    for name, run in get_passes():
+        if PASS_SCOPES.get(name, "repo") != "file":
+            continue
+        t0 = time.perf_counter()
         findings.extend(run(ctx))
+        timings[name] = time.perf_counter() - t0
     findings.extend(ctx.parse_failures)
+    return findings, timings
+
+
+def _run_parallel(
+    ctx: AnalysisContext, jobs: int, timings: Optional[Dict[str, float]]
+) -> List[Finding]:
+    import concurrent.futures
+
+    spec = _context_spec(ctx)
+    # Round-robin over the sorted file list spreads the handful of large
+    # modules (snapshot.py, scheduler.py) across shards.
+    shards = [ctx.lib_files[i::jobs] for i in range(jobs)]
+    shards = [s for s in shards if s]
+    findings: List[Finding] = []
+    with concurrent.futures.ProcessPoolExecutor(max_workers=len(shards)) as pool:
+        futures = [pool.submit(_run_file_shard, spec, s) for s in shards]
+        for fut in futures:
+            shard_findings, shard_timings = fut.result()
+            findings.extend(shard_findings)
+            if timings is not None:
+                for name, dt in shard_timings.items():
+                    timings[name] = timings.get(name, 0.0) + dt
+    for name, run in get_passes():
+        if PASS_SCOPES.get(name, "repo") != "repo":
+            continue
+        t0 = time.perf_counter()
+        findings.extend(run(ctx))
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + time.perf_counter() - t0
+    findings.extend(ctx.parse_failures)
+    # Workers and the parent's repo passes may both parse a broken file and
+    # record its TSA000; identical findings collapse (order-preserving).
+    return list(dict.fromkeys(findings))
+
+
+def run_passes(
+    ctx: AnalysisContext,
+    jobs: int = 1,
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
+    """All passes over ``ctx``, inline-noqa already applied (markdown
+    findings have no noqa mechanism — use the baseline).
+
+    ``jobs > 1`` fans the file-scoped passes out over worker processes
+    (repo-scoped passes still run here); ``timings``, when a dict, is
+    filled with per-pass wall seconds (summed across workers, so parallel
+    numbers read as CPU cost, not latency)."""
+    if jobs > 1 and len(ctx.lib_files) > 1:
+        findings = _run_parallel(ctx, jobs, timings)
+    else:
+        findings = []
+        for name, run in get_passes():
+            t0 = time.perf_counter()
+            findings.extend(run(ctx))
+            if timings is not None:
+                timings[name] = timings.get(name, 0.0) + time.perf_counter() - t0
+        findings.extend(ctx.parse_failures)
     out = []
     for f in findings:
         if f.path.endswith(".py") and is_suppressed(f, ctx.lines(f.path)):

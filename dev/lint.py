@@ -130,7 +130,7 @@ def fix_file(path: str) -> bool:
 
 
 def check_analyzer(paths: list) -> int:
-    """The static-analysis gate (``python -m dev.analyze``): all nine
+    """The static-analysis gate (``python -m dev.analyze``): all ten
     passes (see dev/analyze/__init__.py). Subprocess so the analyzer's
     import path (repo root) never depends on how lint was invoked."""
     import subprocess

@@ -505,3 +505,45 @@ def test_cli_catalog_roundtrip(tmp_path, capsys) -> None:
     assert not os.path.isdir(f"{bucket}/step_0")
     # Bad policy surfaces as the CLI's one-line scriptable error (exit 2).
     assert main(["gc", bucket, "--policy", "weekly=1"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Crash-state exploration of the continuous-checkpointing lifecycle: the
+# runtime counterpart of the static TSA10xx durability pass, over THIS
+# suite's core scenario. CI's crash-explorer slow lane runs the full sweep.
+# ---------------------------------------------------------------------------
+
+def test_continuous_checkpointing_every_effect_prefix_restorable(
+    tmp_path,
+) -> None:
+    """Chained takes + retention GC, journaled effect-by-effect under
+    TORCHSNAPSHOT_TPU_DEBUG_EFFECTS: replaying every prefix of the durable
+    effect order (every crash a single process could suffer) leaves every
+    catalog-visible snapshot bit-exact restorable, no record pointing at a
+    never-committed snapshot, and a GC that converges in one run."""
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from dev import crash_explorer
+    from torchsnapshot_tpu import effect_journal
+
+    bucket = str(tmp_path / "bkt")
+    with knobs.override_debug_effects(True):
+        effect_journal.reset()
+        for i in range(3):
+            Snapshot.take(f"{bucket}/step_{i}", _state(i), job="j", step=i)
+        catalog.retain(
+            bucket, catalog.RetentionPolicy.parse("last=2"), dry_run=False
+        )
+        effects = effect_journal.get_journal().effects()
+    effect_journal.reset()
+    assert any(".catalog/records/" in e.path for e in effects)
+    assert any(e.op == "delete" for e in effects)
+    report = crash_explorer.explore(
+        effects, str(tmp_path / "explore"), seed=3, interior_samples=3
+    )
+    assert report.ok, report.render()
+    assert report.prefixes == len(effects)
+    assert report.interior_samples == 3

@@ -1853,3 +1853,120 @@ def test_chaos_kill_mid_background_drain_with_foreground_restore(
     Snapshot.gc(parent, dry_run=False)
     Snapshot.take(os.path.join(parent, "bg2"), _state(seed=7))
     _assert_restores_bit_exact(os.path.join(parent, "bg2"), seed=7)
+
+
+# ---------------------------------------------------------------------------
+# Durable-effect journal + crash-state explorer: the runtime cross-check of
+# the static TSA10xx durability-discipline pass. The journal records the
+# order mutations reached storage; the explorer replays every prefix (a
+# single-process crash leaves exactly a prefix) and asserts each one is a
+# restorable state. CI's chaos fast lane re-runs this module with
+# TORCHSNAPSHOT_TPU_DEBUG_EFFECTS=1 so every chaos schedule ALSO runs fully
+# journaled.
+# ---------------------------------------------------------------------------
+
+
+def _explorer():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from dev import crash_explorer
+
+    return crash_explorer
+
+
+def test_effect_journal_chaos_schedule_every_prefix_restorable(tmp_path):
+    """Take / retention-GC / retake, journaled effect-by-effect: a crash
+    after ANY durable effect — including mid-GC zombies where the catalog
+    record outlives a deleted ``.snapshot_metadata`` — leaves every
+    catalog-visible snapshot bit-exact restorable and GC convergent."""
+    from torchsnapshot_tpu import effect_journal
+
+    crash_explorer = _explorer()
+    bucket = str(tmp_path / "bucket")
+    with knobs.override_debug_effects(True):
+        effect_journal.reset()
+        Snapshot.take(f"{bucket}/step_1", _state(seed=1), job="chaos")
+        Snapshot.take(f"{bucket}/step_2", _state(seed=2), job="chaos")
+        Snapshot.gc(bucket, dry_run=False, keep_roots={"step_2"})
+        Snapshot.take(f"{bucket}/step_3", _state(seed=3), job="chaos")
+        effects = effect_journal.get_journal().effects()
+    effect_journal.reset()
+    assert any(e.op == "delete" for e in effects)  # the GC is in the journal
+    report = crash_explorer.explore(
+        effects, str(tmp_path / "explore"), seed=0, interior_samples=4
+    )
+    assert report.ok, report.render()
+    assert report.prefixes == len(effects)
+
+
+def test_fault_suppressed_ops_are_never_journaled(tmp_path):
+    """Wrapper-stack order contract: the journal sits BELOW the fault
+    injector, so an op a rule fails never reached storage and never
+    appears in the journal — the journal is ground truth of durability,
+    not of attempts."""
+    from torchsnapshot_tpu import effect_journal
+
+    url = str(tmp_path / "snap")
+    with knobs.override_debug_effects(True):
+        effect_journal.reset()
+        with knobs.override_faults("op=write,kind=fail,times=100"):
+            with pytest.raises(CheckpointAbortedError):
+                Snapshot.take(url, _state(seed=1))
+        effects = effect_journal.get_journal().effects()
+    effect_journal.reset()
+    assert not any(e.op == "write" for e in effects)
+    assert not os.path.exists(os.path.join(url, ".snapshot_metadata"))
+
+
+# ---------------------------------------------------------------------------
+# Derived kill-point op classes (catalog_append / steprecord_append /
+# cache_bitmap): commit-point functions the TSA1004 inventory pins must be
+# reachable by a fault rule that names them.
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_append_fault_class_fires_fail_open(tmp_path):
+    from torchsnapshot_tpu import catalog as catalog_mod
+
+    bucket = str(tmp_path / "bkt")
+    with knobs.override_faults("op=catalog_append,kind=fail,times=10"):
+        Snapshot.take(f"{bucket}/step_1", _state(seed=1), job="j")
+    # Fail-open by contract: the commit is unaffected, the record absent.
+    assert os.path.exists(os.path.join(bucket, "step_1", ".snapshot_metadata"))
+    _assert_restores_bit_exact(f"{bucket}/step_1", seed=1)
+    with catalog_mod.Catalog(bucket) as cat:
+        assert cat.load() == []
+    # Same schedule without the rule: the record lands.
+    Snapshot.take(f"{bucket}/step_2", _state(seed=2), job="j")
+    with catalog_mod.Catalog(bucket) as cat:
+        assert [r.name for r in cat.load()] == ["step_2"]
+
+
+def test_steprecord_append_fault_class_fires_fail_open(tmp_path):
+    from torchsnapshot_tpu import catalog as catalog_mod
+
+    bucket = str(tmp_path / "bkt")
+    with knobs.override_faults("op=steprecord_append,kind=fail,times=10"):
+        Snapshot.take(f"{bucket}/step_1", _state(seed=1), job="j")
+    # The catalog record survives; only the telemetry rollup is lost.
+    with catalog_mod.Catalog(bucket) as cat:
+        assert [r.name for r in cat.load()] == ["step_1"]
+    telemetry_dir = os.path.join(bucket, catalog_mod.STEP_TELEMETRY_DIR)
+    assert not any(files for _, _, files in os.walk(telemetry_dir))
+    _assert_restores_bit_exact(f"{bucket}/step_1", seed=1)
+
+
+def test_cache_bitmap_fault_class_reaches_local_injector():
+    """The bitmap rename is a commit point BELOW the plugin wrapper; rules
+    reach it via faults.maybe_inject_local. Derived classes never match
+    op=any — they must be named explicitly (else every generic schedule
+    would double-fire at derived call sites)."""
+    from torchsnapshot_tpu import faults
+
+    with knobs.override_faults("op=cache_bitmap,kind=fail"):
+        with pytest.raises(faults.InjectedFault):
+            faults.maybe_inject_local("cache_bitmap", "objs/x.bitmap")
+    with knobs.override_faults("op=any,kind=fail"):
+        faults.maybe_inject_local("cache_bitmap", "objs/x.bitmap")  # no fire
+    faults.maybe_inject_local("cache_bitmap", "objs/x.bitmap")  # spec unset

@@ -1129,6 +1129,283 @@ def test_baseline_grandfathers_and_detects_stale(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass 10: durability-discipline (TSA1001-TSA1004)
+# ---------------------------------------------------------------------------
+
+
+def test_durability_flags_bare_final_path_write(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def dump_table(path, rows):
+                with open(path, "w") as f:
+                    f.write(rows)
+            """,
+        },
+    )
+    found = [f for f in run_passes(ctx) if f.code == "TSA1001"]
+    assert len(found) == 1
+    assert found[0].key == "bare-open:dump_table"
+
+
+def test_durability_quiet_on_atomic_idioms_and_noqa(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def atomic_dump(path, rows):
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(rows)
+                os.replace(tmp, path)
+
+            def rename_commit(work, final):
+                # Not tmp-NAMED, but os.replace()d in place: still atomic.
+                with open(work, "wb") as f:
+                    f.write(b"x")
+                os.replace(work, final)
+
+            def routed(storage, write_io):
+                storage.sync_write(write_io)
+
+            def documented_sidecar(path):
+                with open(path, "w") as f:  # noqa: TSA1001
+                    f.write("fail-open by design")
+            """,
+        },
+    )
+    assert [f for f in run_passes(ctx) if f.code == "TSA1001"] == []
+
+
+def test_durability_flags_publish_not_dominated_by_commit(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            class Snap:
+                def commit(self, ok):
+                    if ok:
+                        self._write_snapshot_metadata()
+                    self._append_catalog_record()
+            """,
+        },
+    )
+    found = [f for f in run_passes(ctx) if f.code == "TSA1002"]
+    assert len(found) == 1
+    assert found[0].key == (
+        "publish-before-commit:Snap.commit:_append_catalog_record"
+    )
+
+
+def test_durability_quiet_when_commit_dominates_publish(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            class Snap:
+                def commit(self):
+                    self._write_snapshot_metadata()
+                    self._append_catalog_record()
+                    self._append_step_telemetry_record()
+
+                def unrelated(self):
+                    return 1
+            """,
+        },
+    )
+    assert [f for f in run_passes(ctx) if f.code == "TSA1002"] == []
+
+
+def test_durability_flags_ungated_gc_delete(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def gc_sweep(paths):
+                for p in paths:
+                    os.remove(p)
+            """,
+        },
+    )
+    found = [f for f in run_passes(ctx) if f.code == "TSA1003"]
+    assert len(found) == 1
+    assert found[0].key == "ungated-delete:gc_sweep"
+
+
+def test_durability_quiet_on_keep_gated_delete_and_non_gc_scope(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def gc_sweep(paths, keep):
+                for p in paths:
+                    if p not in keep:
+                        os.remove(p)
+
+            def evict_entries(storage, victims, pinned):
+                for v in victims:
+                    if v in pinned:
+                        continue
+                    storage.delete(v)
+
+            def replace_artifact(path):
+                # A delete outside GC/retention scope is not this rule's
+                # business (resource cleanup, overwrite-then-delete, ...).
+                os.remove(path)
+            """,
+        },
+    )
+    assert [f for f in run_passes(ctx) if f.code == "TSA1003"] == []
+
+
+def _durability_ctx(tmp_path, faults_src):
+    return make_ctx(
+        tmp_path,
+        {
+            "pkg/writer.py": """
+            import os
+
+            def finalize(tmp, dst):
+                os.replace(tmp, dst)
+            """,
+            "faults.py": faults_src,
+        },
+        faults_path="faults.py",
+    )
+
+
+def test_durability_crash_surface_pins_commit_points(tmp_path):
+    ctx = _durability_ctx(
+        tmp_path,
+        """
+        _OPS = ("write", "commit", "any")
+        _CRASH_SURFACE = (
+            ("writer.py:finalize", "commit"),
+        )
+        """,
+    )
+    assert [f for f in run_passes(ctx) if f.code == "TSA1004"] == []
+
+
+def test_durability_flags_unpinned_stale_and_bad_op(tmp_path):
+    ctx = _durability_ctx(
+        tmp_path,
+        """
+        _OPS = ("write", "commit", "any")
+        _CRASH_SURFACE = (
+            ("writer.py:gone", "commit"),
+            ("writer.py:finalize", "explode"),
+        )
+        """,
+    )
+    keys = sorted(f.key for f in run_passes(ctx) if f.code == "TSA1004")
+    # finalize IS in the table (so not unpinned) but names a made-up op
+    # class; gone isn't a discoverable commit point anymore.
+    assert keys == [
+        "badop:writer.py:finalize:explode",
+        "stale:writer.py:gone",
+    ]
+
+    unpinned = _durability_ctx(
+        tmp_path / "unpinned",
+        """
+        _OPS = ("write", "commit", "any")
+        _CRASH_SURFACE = ()
+        """,
+    )
+    keys = [f.key for f in run_passes(unpinned) if f.code == "TSA1004"]
+    assert keys == ["unpinned:writer.py:finalize"]
+
+
+def test_durability_flags_missing_crash_surface_table(tmp_path):
+    ctx = _durability_ctx(tmp_path, "_OPS = ('write', 'any')\n")
+    keys = [f.key for f in run_passes(ctx) if f.code == "TSA1004"]
+    assert keys == ["no-crash-surface"]
+
+
+def test_crash_surface_table_matches_discovered_inventory():
+    """Satellite of the TSA1004 gate, asserted directly against the live
+    modules: the reviewable ``faults._CRASH_SURFACE`` mirror, the pass's
+    discovered inventory, and the catalog layout can never drift apart."""
+    from dev.analyze.durability_discipline import discover_commit_points
+    from torchsnapshot_tpu import catalog, faults
+
+    inventory = discover_commit_points(default_context(REPO_ROOT))
+    table = dict(faults._CRASH_SURFACE)
+    assert set(table) == set(inventory)
+    assert set(table.values()) <= set(faults._OPS) | {"fail-open"}
+    # Derived write classes stay glued to the catalog's real layout, and
+    # each names a rule-matchable op class.
+    assert faults._CATALOG_RECORD_PREFIX == f"{catalog.RECORD_DIR}/"
+    assert faults._STEP_TELEMETRY_PREFIX == f"{catalog.STEP_TELEMETRY_DIR}/"
+    assert faults._DERIVED_OP_SET <= set(faults._OPS)
+
+
+# ---------------------------------------------------------------------------
+# --jobs / --timings plumbing
+# ---------------------------------------------------------------------------
+
+
+def _two_file_ctx(tmp_path):
+    return make_ctx(
+        tmp_path,
+        {
+            "a.py": """
+            def dump_a(path):
+                with open(path, "w") as f:
+                    f.write("a")
+            """,
+            "b.py": """
+            def dump_b(path):
+                with open(path, "w") as f:
+                    f.write("b")
+            """,
+        },
+    )
+
+
+def test_run_passes_parallel_matches_serial_and_times_passes(tmp_path):
+    from dev.analyze import get_passes
+
+    serial_timings = {}
+    serial = run_passes(_two_file_ctx(tmp_path), timings=serial_timings)
+    parallel_timings = {}
+    parallel = run_passes(
+        _two_file_ctx(tmp_path), jobs=2, timings=parallel_timings
+    )
+    assert serial == parallel
+    assert sorted(f.key for f in serial) == ["bare-open:dump_a", "bare-open:dump_b"]
+    pass_names = {name for name, _ in get_passes()}
+    assert set(serial_timings) == pass_names
+    assert set(parallel_timings) == pass_names
+    assert all(t >= 0 for t in parallel_timings.values())
+
+
+@pytest.mark.slow
+def test_analyzer_cli_jobs_and_timings_smoke():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dev.analyze", "--jobs", "2", "--timings"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analyzer clean" in proc.stdout
+    assert "per-pass wall time" in proc.stdout
+    assert "durability-discipline" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
 # Repo gates
 # ---------------------------------------------------------------------------
 

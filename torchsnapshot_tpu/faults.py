@@ -26,6 +26,16 @@ Spec grammar (rules separated by ``;``, fields by ``,``)::
 
     op    = write | read | delete | stream_open | append | commit | abort
           | link | list | peer_serve | any
+          | catalog_append | steprecord_append | cache_bitmap
+
+    ``catalog_append`` / ``steprecord_append`` are *derived* write classes:
+    they fire at plugin writes landing under the catalog's record /
+    step-telemetry directories, so a kill-point can target exactly the
+    lifecycle layer's publish ops without counting data writes. Rules must
+    name them explicitly (``op=any`` does not match a derived class twice).
+    ``cache_bitmap`` fires at the sparse read-cache's bitmap-rename commit
+    point (``storage_plugins/cache.py``), which lives BELOW this wrapper —
+    it is driven through :func:`maybe_inject_local` instead of ``_guard``.
 
     ``peer_serve`` is not a storage op: it fires at the swarm restore's
     peer-serving point, just before a rank posts a fetched chunk for its
@@ -97,6 +107,8 @@ import asyncio
 import logging
 import os
 import random
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -122,8 +134,29 @@ _OPS = (
     "link",
     "list",
     "peer_serve",
+    "catalog_append",
+    "steprecord_append",
+    "cache_bitmap",
     "any",
 )
+
+# Derived write classes: a plugin write whose path starts with one of these
+# prefixes ALSO runs that class's injection point (when a rule names it),
+# so kill-points can target the lifecycle layer's publish ops — the commit
+# points the TSA1004 durability pass pins — without counting data writes.
+# Kept as literals (the static-analysis coverage test asserts they match
+# ``catalog.RECORD_DIR`` / ``catalog.STEP_TELEMETRY_DIR``) so importing
+# this module never pulls the catalog machinery.
+_CATALOG_RECORD_PREFIX = ".catalog/records/"
+_STEP_TELEMETRY_PREFIX = ".catalog/telemetry/"
+
+_DERIVED_WRITE_OPS = (
+    ("catalog_append", _CATALOG_RECORD_PREFIX),
+    ("steprecord_append", _STEP_TELEMETRY_PREFIX),
+)
+_DERIVED_OP_SET = frozenset(
+    op for op, _ in _DERIVED_WRITE_OPS
+) | {"cache_bitmap"}
 _KINDS = ("transient", "fail", "torn", "stall", "kill", "corrupt")
 
 # Plugin surface the wrapper deliberately proxies WITHOUT an injection
@@ -133,6 +166,49 @@ _KINDS = ("transient", "fail", "torn", "stall", "kill", "corrupt")
 # override at all) fails the gate, so new plugin surface can never silently
 # bypass chaos testing.
 _PASSTHROUGH_OPS = ("prune_empty", "close")
+
+# The commit-point inventory: every function the TSA1004 durability pass
+# discovers performing a direct durable mutation (os.replace/rename/link/
+# remove/unlink, or a mutating call on a storage plugin), pinned to the
+# kill-point op class whose rules reach it — so a chaos schedule can crash
+# the process at exactly that commit point. "fail-open" declares a site
+# whose loss is harmless by contract (telemetry sidecars, local cache
+# entries the next read re-populates, build artifacts): not crash-surface,
+# reviewed here so the declaration is explicit. The pass fails on any
+# drift in either direction (an unpinned discovery, a stale entry), and
+# tests/test_static_analysis.py asserts this table equals the pass's
+# inventory exactly.
+_CRASH_SURFACE = (
+    ("__init__.py:_build", "fail-open"),  # native .so build artifact
+    ("aggregate.py:write_merged_chrome_trace", "fail-open"),
+    ("cache.py:CachedStoragePlugin._drop_entry", "fail-open"),
+    ("cache.py:CachedStoragePlugin._maybe_evict", "fail-open"),
+    ("cache.py:CachedStoragePlugin._read_entry_pinned", "fail-open"),
+    ("cache.py:CachedStoragePlugin._replace_bitmap", "cache_bitmap"),
+    ("cache.py:CachedStoragePlugin._write_entry", "fail-open"),
+    ("cache.py:CachedStoragePlugin._write_entry_range", "fail-open"),
+    ("cache.py:CachedStoragePlugin.quarantine_path", "fail-open"),
+    ("catalog.py:Catalog.append", "catalog_append"),
+    ("catalog.py:Catalog.append_step_telemetry", "steprecord_append"),
+    ("catalog.py:Catalog.pin", "write"),
+    ("catalog.py:Catalog.unpin", "delete"),
+    ("export.py:write_chrome_trace", "fail-open"),
+    ("fs.py:FSStoragePlugin._link_in_inner", "link"),
+    ("fs.py:FSStoragePlugin._write_inner", "write"),
+    ("fs.py:_FSWriteStream._abort_work", "abort"),
+    ("fs.py:_FSWriteStream._commit_work", "commit"),
+    ("gcs.py:_GCSWriteStream.commit", "commit"),
+    ("io_types.py:BufferedWriteStream.commit", "commit"),
+    ("recorder.py:FlightRecorder.dump", "fail-open"),
+    ("s3.py:_S3WriteStream.commit", "commit"),
+    ("scheduler.py:_WritePipeline._stream_one", "append"),
+    ("scheduler.py:_WritePipeline._write_one", "write"),
+    ("scheduler.py:_WritePipeline.run_to_completion", "write"),
+    ("snapshot.py:Snapshot._scrub_repair", "write"),
+    ("snapshot.py:Snapshot._write_snapshot_metadata", "write"),
+    ("snapshot.py:Snapshot.gc", "delete"),
+    ("storage_plugin.py:write_telemetry_artifact", "write"),
+)
 
 # Exit code of a `kill` fault — distinctive so the chaos harness (and a
 # human reading a CI log) can tell an injected death from a real crash.
@@ -354,6 +430,8 @@ class FaultyStoragePlugin(StoragePlugin):
         index = self._counters.get(op, 0)
         self._counters[op] = index + 1
         for rule in self.plan.rules:
+            if op in _DERIVED_OP_SET and rule.op != op:
+                continue  # derived classes match only rules naming them
             if rule.matches(op, index, path, self._rng, self._rank):
                 rule.injected += 1
                 return _Action(kind=rule.kind, rule=rule)
@@ -395,9 +473,17 @@ class FaultyStoragePlugin(StoragePlugin):
             base_backoff_s=self.plan.backoff_s,
         )
 
+    def _has_rule_for(self, op: str) -> bool:
+        return any(rule.op == op for rule in self.plan.rules)
+
     # ------------------------------------------------------------------- ops
     async def write(self, write_io: WriteIO) -> None:
         async def run() -> None:
+            for derived, prefix in _DERIVED_WRITE_OPS:
+                if write_io.path.startswith(prefix) and self._has_rule_for(
+                    derived
+                ):
+                    await self._guard(derived, write_io.path)
             act = await self._guard("write", write_io.path)
             if act is not None and act.kind == "torn":
                 # Simulated crash mid-write: push `bytes` bytes into a real
@@ -597,3 +683,82 @@ def maybe_wrap_with_faults(plugin: StoragePlugin) -> StoragePlugin:
     if not spec:
         return plugin
     return FaultyStoragePlugin(plugin, parse_fault_spec(spec))
+
+
+# ---------------------------------------------------------------------------
+# Local (below-the-wrapper) injection points.
+#
+# Some commit points live INSIDE a plugin the wrapper stacks above — the
+# sparse read-cache's bitmap rename is the canonical one — so no storage op
+# ever traverses their class through `_guard`. `maybe_inject_local` gives
+# those sites a kill-point of their own: a synchronous injection point
+# driven by the SAME `TORCHSNAPSHOT_TPU_FAULTS` spec (its own per-op
+# counters, its own seeded RNG), matching only rules that name the op class
+# explicitly. Unset knob: one env read, no allocation, nothing imported.
+# ---------------------------------------------------------------------------
+
+
+class _LocalInjector:
+    """Per-process sync injector for plugin-internal commit points."""
+
+    def __init__(self, spec: str) -> None:
+        self.spec = spec
+        self.plan = parse_fault_spec(spec)
+        self._rng = random.Random(self.plan.seed)
+        self._rank = _current_rank()
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inject(self, op: str, path: str) -> None:
+        with self._lock:
+            index = self._counters.get(op, 0)
+            self._counters[op] = index + 1
+            act = None
+            for rule in self.plan.rules:
+                if rule.op != op:
+                    continue  # local classes match only rules naming them
+                if rule.matches(op, index, path, self._rng, self._rank):
+                    rule.injected += 1
+                    act = rule
+                    break
+        if act is None:
+            return
+        telemetry.counter_add(f"faults.{act.kind}")
+        if act.kind == "stall":
+            logger.warning(
+                "FAULT stall %.2fs on %s %s", act.secs, op, path
+            )
+            time.sleep(act.secs)
+            return
+        if act.kind == "kill":
+            logger.warning("FAULT kill at %s %s", op, path)
+            os._exit(KILL_EXIT_CODE)
+        if act.kind == "transient":
+            raise InjectedTransientFault(
+                f"injected transient {op} fault: {path}"
+            )
+        # fail / torn / corrupt all surface as a permanent failure here:
+        # these sites are synchronous one-shot commits with no partial
+        # transfer or read buffer to manipulate.
+        raise InjectedFault(f"injected {op} failure: {path}")
+
+
+_LOCAL_INJECTOR: Optional[_LocalInjector] = None
+_LOCAL_LOCK = threading.Lock()
+
+
+def maybe_inject_local(op: str, path: str) -> None:
+    """Run a plugin-internal injection point (no-op unless the faults knob
+    is set AND the spec names ``op``). Callers sit below the wrapper stack,
+    so this is their only road into chaos schedules."""
+    from .utils import knobs
+
+    spec = knobs.get_faults_spec()
+    if not spec:
+        return
+    global _LOCAL_INJECTOR
+    with _LOCAL_LOCK:
+        if _LOCAL_INJECTOR is None or _LOCAL_INJECTOR.spec != spec:
+            _LOCAL_INJECTOR = _LocalInjector(spec)
+        injector = _LOCAL_INJECTOR
+    injector.inject(op, path)

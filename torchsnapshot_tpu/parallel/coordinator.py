@@ -77,10 +77,13 @@ class Coordinator:
         self._posted.append((self._generation, ns_key))
 
     def _gc_posted(self) -> None:
+        # Ephemeral KV collective keys, not durable snapshot state: the
+        # "keep-set" is the generation watermark the while-condition
+        # enforces (only keys a full-world barrier proved consumed go).
         while self._posted and self._posted[0][0] < self._last_barrier_gen:
             _, key = self._posted.pop(0)
             try:
-                self._store.delete(key)
+                self._store.delete(key)  # noqa: TSA1003
             except Exception:
                 break  # cleanup is best-effort
 

@@ -70,6 +70,15 @@ def url_to_storage_plugin(url_path: str) -> StoragePlugin:
     plugin = _resolve_storage_plugin(url_path)
     from .utils import knobs
 
+    if knobs.is_debug_effects_enabled():
+        # Durable-effect journal (debug/tests only): the BOTTOM of the
+        # wrapper stack, directly above the real backend, so it records
+        # exactly the mutations that reached storage — including a torn
+        # write's partial append, excluding ops a fault rule suppressed.
+        # See effect_journal.py / dev/crash_explorer.py.
+        from .effect_journal import maybe_wrap_with_effects
+
+        plugin = maybe_wrap_with_effects(plugin, origin=url_path)
     if knobs.get_read_cache_dir():
         # Content-addressed read-through cache (serving fleets: K replicas
         # cold-start from one snapshot, the origin is read once). Wrapped

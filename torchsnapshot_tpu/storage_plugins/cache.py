@@ -459,11 +459,15 @@ class CachedStoragePlugin(StoragePlugin):
                     self._replace_bitmap(bitmap_path, bytes(n))
                 if not os.path.exists(entry):
                     os.makedirs(os.path.dirname(entry), exist_ok=True)
-                    with open(entry, "wb") as f:
+                    # Sparse writes are deliberately non-atomic on the DATA
+                    # file: chunks are published by the bitmap's atomic
+                    # rename (_replace_bitmap), so a torn write here is
+                    # never marked present and the next read re-fetches.
+                    with open(entry, "wb") as f:  # noqa: TSA1001
                         f.truncate(size)
                     created = True
                 span_b, span_e = c0 * grain, min(c1 * grain, size)
-                with open(entry, "r+b") as f:
+                with open(entry, "r+b") as f:  # noqa: TSA1001
                     f.seek(span_b)
                     f.write(data[span_b - begin : span_e - begin])
                 with open(bitmap_path, "rb") as f:
@@ -494,6 +498,13 @@ class CachedStoragePlugin(StoragePlugin):
         try:
             with open(tmp, "wb") as f:
                 f.write(content)
+            if knobs.get_faults_spec():
+                # The bitmap rename is a commit point BELOW the fault
+                # wrapper: this is its only road into chaos schedules
+                # (`op=cache_bitmap`). See faults.maybe_inject_local.
+                from .. import faults
+
+                faults.maybe_inject_local("cache_bitmap", bitmap_path)
             os.replace(tmp, bitmap_path)
         except BaseException:
             with contextlib.suppress(OSError):

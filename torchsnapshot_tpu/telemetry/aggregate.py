@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from .artifact import artifact_path, parse_artifact
@@ -431,5 +432,9 @@ def merged_chrome_trace(artifacts: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
 def write_merged_chrome_trace(
     artifacts: Dict[int, Dict[str, Any]], path: str
 ) -> None:
-    with open(path, "w", encoding="utf-8") as f:
+    """Atomic (tmp + replace): a crashed export never leaves a torn trace
+    for a trace viewer or a concurrent reader to choke on."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(merged_chrome_trace(artifacts), f)
+    os.replace(tmp, path)

@@ -15,6 +15,7 @@ traces still parse.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List
 
 from .core import Span, Telemetry
@@ -67,8 +68,12 @@ def to_chrome_trace(tm: Telemetry) -> Dict[str, Any]:
 
 
 def write_chrome_trace(tm: Telemetry, path: str) -> None:
-    with open(path, "w", encoding="utf-8") as f:
+    """Atomic (tmp + replace): a crashed export never leaves a torn trace
+    for a trace viewer or a concurrent reader to choke on."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(to_chrome_trace(tm), f)
+    os.replace(tmp, path)
 
 
 def spans_from_chrome_trace(trace: Dict[str, Any]) -> List[Span]:

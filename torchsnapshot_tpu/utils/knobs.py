@@ -944,6 +944,31 @@ def override_debug_collectives(enabled: bool):
     return _override_env(_ENV_DEBUG_COLLECTIVES, "1" if enabled else "0")
 
 
+_ENV_DEBUG_EFFECTS = "TORCHSNAPSHOT_TPU_DEBUG_EFFECTS"
+
+
+def is_debug_effects_enabled() -> bool:
+    """Debug-mode durable-effect journal: when set, every storage plugin
+    ``url_to_storage_plugin`` constructs is wrapped in an
+    :class:`~torchsnapshot_tpu.effect_journal.EffectRecordingPlugin` that
+    records each mutating op (write / stream open / append / commit / abort
+    / delete / link) as one sequence-numbered journal entry carrying the
+    op class, path, content fingerprint, payload, and originating call
+    site. The journal is the input to the crash-state explorer
+    (``dev/crash_explorer.py``), which replays every effect prefix and
+    asserts each one is a restorable crash state — the runtime cross-check
+    of the static TSA10xx durability-discipline pass (see
+    ``effect_journal.py`` and ``docs/robustness.md``). Off (the default)
+    allocates nothing; the wrapper is never even imported."""
+    return os.environ.get(_ENV_DEBUG_EFFECTS, "") not in (
+        "", "0", "false", "False",
+    )
+
+
+def override_debug_effects(enabled: bool):
+    return _override_env(_ENV_DEBUG_EFFECTS, "1" if enabled else "0")
+
+
 _ENV_READ_CACHE_DIR = "TORCHSNAPSHOT_TPU_READ_CACHE_DIR"
 _ENV_READ_CACHE_BYTES = "TORCHSNAPSHOT_TPU_READ_CACHE_BYTES"
 _ENV_READ_CACHE_VERIFY = "TORCHSNAPSHOT_TPU_READ_CACHE_VERIFY"
