@@ -97,6 +97,19 @@ class CompressedSlabStager(BufferStager):
         self.level = level
         self.frame_sizes: Optional[List[int]] = None
         self.frame_error: Optional[BaseException] = None
+        # frame_sizes is published from an executor thread (work()) and
+        # cleared loop-side between takes (reset_take, prepared cache);
+        # the pipeline serializes the two in time, the lock makes the
+        # cross-thread hand-off well-defined.
+        self._frame_lock = threading.Lock()
+
+    def reset_take(self) -> None:
+        """Clear per-take frame publication so a cached prepared state can
+        re-stage this slab for a new step (the member stagers were rebound
+        by the prepared cache; offsets/sizes are structural and keep)."""
+        with self._frame_lock:
+            self.frame_sizes = None
+            self.frame_error = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         from . import d2h
@@ -118,7 +131,8 @@ class CompressedSlabStager(BufferStager):
                     times.record(
                         "serialize", t0, time.monotonic(), nbytes=len(payload)
                     )
-                self.frame_sizes = sizes
+                with self._frame_lock:
+                    self.frame_sizes = sizes
                 return payload
 
             if executor is not None:

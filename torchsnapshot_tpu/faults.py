@@ -201,10 +201,16 @@ _CRASH_SURFACE = (
     ("io_types.py:BufferedWriteStream.commit", "commit"),
     ("recorder.py:FlightRecorder.dump", "fail-open"),
     ("s3.py:_S3WriteStream.commit", "commit"),
+    ("scheduler.py:_WritePipeline._storage_write", "write"),
     ("scheduler.py:_WritePipeline._stream_one", "append"),
     ("scheduler.py:_WritePipeline._write_one", "write"),
     ("scheduler.py:_WritePipeline.run_to_completion", "write"),
     ("snapshot.py:Snapshot._scrub_repair", "write"),
+    # A/B probe writes throwaway `.probe` objects outside any snapshot
+    # directory's commit protocol; a crash mid-probe orphans at most one
+    # probe object and can never corrupt a snapshot.
+    ("stream_select.py:_probe_streamed", "fail-open"),
+    ("stream_select.py:_probe_whole", "fail-open"),
     ("snapshot.py:Snapshot._write_snapshot_metadata", "write"),
     ("snapshot.py:Snapshot.gc", "delete"),
     ("storage_plugin.py:write_telemetry_artifact", "write"),

@@ -360,6 +360,41 @@ def _batch_copy_fn(shardings: Tuple[Any, ...]):
 _BATCH_COPIES = BoundedLRU()
 
 
+def capture_flattened(
+    flattened: Dict[str, Any], timings: Optional[Dict[str, float]] = None
+) -> Dict[str, Any]:
+    """The async-take capture step, shared by the full prepare path and the
+    prepared-cache rebind path (``prepare_cache.py``): detach device arrays
+    from the training step before ``async_take`` returns.
+
+    Under the default ``fork`` capture mode this dispatches the defensive
+    on-device copies (donation safety — see ``_defensive_device_copies``).
+    Under ``donate`` (``TORCHSNAPSHOT_TPU_ASYNC_CAPTURE=donate``) the
+    caller has promised not to donate or delete the passed arrays until
+    the snapshot commits, so the immutable arrays are captured ZERO-COPY:
+    no fork, no HBM overhead, capture cost ~0 — the steady-state mode.
+
+    Returns ``flattened`` with device leaves replaced by their captures
+    (the input dict is never mutated); ``timings["d2h_hint"]`` accumulates
+    the capture wall time."""
+    device_paths = [p for p, v in flattened.items() if _is_jax_array(v)]
+    if (
+        not device_paths
+        or not knobs.is_async_device_copy_enabled()
+        or knobs.get_async_capture_mode() == "donate"
+    ):
+        return flattened
+    t0 = time.monotonic()
+    copies = _defensive_device_copies([flattened[p] for p in device_paths])
+    if timings is not None:
+        timings["d2h_hint"] = timings.get("d2h_hint", 0.0) + (
+            time.monotonic() - t0
+        )
+    flattened = dict(flattened)
+    flattened.update(zip(device_paths, copies))
+    return flattened
+
+
 def prepare_write(
     flattened: Dict[str, Any],
     rank: int,
@@ -367,6 +402,7 @@ def prepare_write(
     replicated_paths: Set[str],
     is_async_snapshot: bool = False,
     timings: Optional[Dict[str, float]] = None,
+    leaf_index: Optional[Dict[str, List[WriteReq]]] = None,
 ) -> Tuple[Manifest, List[WriteReq]]:
     """Plan all writes for this rank's flattened state (no data moves yet).
 
@@ -377,25 +413,27 @@ def prepare_write(
     ``plan`` (classification, path mapping, everything else). The take
     path persists them as sub-spans of the ``prepare_write`` stall phase,
     so the stall decomposition's dominant phase is attributable instead of
-    a single opaque number."""
+    a single opaque number.
+
+    ``leaf_index``: optional out-param mapping each logical path to the
+    write requests its leaf produced, in construction order — the
+    prepared-state cache's rebind map (``prepare_cache.py``). Primitives
+    record an empty list (manifest entry only)."""
     t_begin = time.monotonic()
     d2h_hint_s = 0.0
     stager_s = 0.0
     manifest: Manifest = {}
     write_reqs: List[WriteReq] = []
     if is_async_snapshot:
-        # Device arrays are immutable; fork them against donation and defer
+        # Device arrays are immutable; fork them against donation (or
+        # capture them zero-copy under the donate contract) and defer
         # their staging past async_take's return. Mutable host state keeps
         # defer_staging=False and is captured (staged under the budget)
         # before async_take returns — the reference's semantics
         # (``scheduler.py:178-214``).
-        device_paths = [p for p, v in flattened.items() if _is_jax_array(v)]
-        if device_paths and knobs.is_async_device_copy_enabled():
-            t0 = time.monotonic()
-            copies = _defensive_device_copies([flattened[p] for p in device_paths])
-            d2h_hint_s += time.monotonic() - t0
-            flattened = dict(flattened)
-            flattened.update(zip(device_paths, copies))
+        capture_timings: Dict[str, float] = {}
+        flattened = capture_flattened(flattened, capture_timings)
+        d2h_hint_s += capture_timings.get("d2h_hint", 0.0)
     device_paths_set = {p for p, v in flattened.items() if _is_plannable_array(v)}
     for logical_path, value in flattened.items():
         is_device_value = logical_path in device_paths_set
@@ -411,6 +449,8 @@ def prepare_write(
             manifest[logical_path] = PrimitiveEntry.from_value(
                 value, replicated=glob_replicated
             )
+            if leaf_index is not None:
+                leaf_index[logical_path] = []
             continue
 
         if kind == "sharded":
@@ -425,6 +465,8 @@ def prepare_write(
             if is_async_snapshot:
                 for r in reqs:
                     r.defer_staging = True
+            if leaf_index is not None:
+                leaf_index[logical_path] = list(reqs)
             write_reqs.extend(reqs)
             continue
 
@@ -455,6 +497,8 @@ def prepare_write(
             if is_async_snapshot and is_device_value:
                 for r in reqs:
                     r.defer_staging = True
+            if leaf_index is not None:
+                leaf_index[logical_path] = list(reqs)
             write_reqs.extend(reqs)
             continue
 
@@ -466,6 +510,8 @@ def prepare_write(
         )
         stager_s += time.monotonic() - t0
         manifest[logical_path] = entry
+        if leaf_index is not None:
+            leaf_index[logical_path] = list(reqs)
         write_reqs.extend(reqs)
     if timings is not None:
         total = time.monotonic() - t_begin

@@ -178,6 +178,24 @@ class ArrayBufferStager(BufferStager):
         # when this request will stream (see the note there).
         self._first_slice = None
 
+    def rebind(self, arr: Any) -> None:
+        """Point this stager at a new step's array and clear per-take state
+        (frame publication, pre-hinted slices) while keeping the structural
+        plan — entry, compression level, slab membership (``stage_raw``) —
+        exactly as prepared. The prepared-state cache's hit path: the new
+        array must match the cached plan's shape/dtype (guaranteed by the
+        cache's fingerprint key)."""
+        self.arr = arr
+        self.frame_sizes = None
+        self.frame_error = None
+        self._first_slice = None
+
+    def unbind(self) -> None:
+        """Drop the array reference between takes so a cached prepared
+        state never pins device/host buffers past its pipeline's commit."""
+        self.arr = None
+        self._first_slice = None
+
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         if not self.entry.frame_bytes:
             return await self._stage_inner(executor)

@@ -26,6 +26,14 @@ class ObjectBufferStager(BufferStager):
     def __init__(self, obj: Any) -> None:
         self.obj = obj
 
+    def rebind(self, obj: Any) -> None:
+        """Swap in the new step's object (prepared-cache hit path); the
+        pickle happens at stage time so nothing else is stale."""
+        self.obj = obj
+
+    def unbind(self) -> None:
+        self.obj = None
+
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         loop = asyncio.get_running_loop()
         dump = lambda: pickle.dumps(self.obj, protocol=pickle.HIGHEST_PROTOCOL)

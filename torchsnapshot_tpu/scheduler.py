@@ -64,7 +64,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import psutil
 
-from . import d2h, hashing, telemetry
+from . import d2h, hashing, stream_select, telemetry
 from .engine import GraphExecutor, Node, Priority
 from .engine.executor import Budget as _Budget  # noqa: F401 - test surface
 from .engine.executor import ProgressReporter as _ProgressReporter  # noqa: F401
@@ -111,14 +111,10 @@ _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 
 
-def _storage_label(storage) -> str:
-    """Short plugin label for per-plugin metric names: ``FSStoragePlugin``
-    → ``fs``, ``CachedStoragePlugin`` → ``cached`` — matching the names the
-    plugins themselves use in ``storage.<plugin>.write_bytes``."""
-    name = type(storage).__name__
-    if name.endswith("StoragePlugin"):
-        name = name[: -len("StoragePlugin")]
-    return name.lower() or "unknown"
+# Short plugin label for per-plugin metric names: ``FSStoragePlugin`` →
+# ``fs``, matching ``storage.<plugin>.write_bytes``. Canonical home is
+# stream_select (the auto-select scorecard keys on the same label).
+_storage_label = stream_select.storage_label
 
 
 def _chunk_size_bucket(nbytes: int) -> str:
@@ -368,6 +364,10 @@ class _WritePipeline:
         self._built = True
         self._stream_chunk = knobs.get_stream_chunk_bytes()
         self._stream_inflight = knobs.get_stream_inflight()
+        # One streaming decision per pipeline: the knob verbatim when
+        # forced, the per-plugin measured-throughput decision under auto
+        # (stream_select module docstring — the r07 inversion fix).
+        self._stream_on = stream_select.resolve(self.storage)
         by_size = sorted(
             self._write_reqs,
             key=lambda r: -r.buffer_stager.get_staging_cost_bytes(),
@@ -432,7 +432,7 @@ class _WritePipeline:
         chunk exists to overlap with, and the take has no incremental base
         (dedup must see the whole object's digest BEFORE deciding link-in
         vs write; a stream has already appended by then)."""
-        if not knobs.is_stream_writes_enabled():
+        if not self._stream_on:
             return False
         if not getattr(self.storage, "supports_streaming", False):
             return False
@@ -490,7 +490,15 @@ class _WritePipeline:
         async def stage(ctx, _payload):
             if self.executor is None:
                 self.executor = self.pools.staging_executor()
+            t0 = time.monotonic()
             buf = await req.buffer_stager.stage_buffer(self.executor)
+            # Auto-select evidence, staging side (whole-buffer): keeps the
+            # two sides' rates comparable — both are bytes per BUSY second
+            # including staging, so the streamed path's per-chunk overhead
+            # asymmetry is what the decision actually weighs.
+            stream_select.note_whole_stage(
+                _storage_label(self.storage), time.monotonic() - t0
+            )
             nbytes = memoryview(buf).nbytes
             self.bytes_staged += nbytes
             self.progress.note_staged(nbytes, estimate=cost)
@@ -608,6 +616,12 @@ class _WritePipeline:
                         outstanding += nbytes - chunk_est
                     chunks += 1
                     ctx.record_interval("stream_chunk", t0, req.path, nbytes)
+                    # Auto-select evidence, staging side: the per-chunk
+                    # slice/copy/serialize cost is the overhead that
+                    # inverted r07's A/B — it must weigh against streaming.
+                    stream_select.note_stream_stage(
+                        storage_label, time.monotonic() - t0
+                    )
                     self.progress.note_staged(nbytes)
                     await queue.put((buf, nbytes))
             finally:
@@ -638,7 +652,12 @@ class _WritePipeline:
                     await hasher.feed(buf)
                 t0 = time.monotonic()
                 await stream.append(buf)
+                append_s = time.monotonic() - t0
                 ctx.record_interval("io", t0, req.path, nbytes)
+                # Auto-select evidence: streamed bytes + append seconds per
+                # plugin (unconditional — the scorecard must accumulate
+                # without a telemetry session).
+                stream_select.note_streamed(storage_label, nbytes, append_s)
                 if self._tm is not None:
                     # Per-chunk append latency by plugin and size bucket —
                     # the data that attributes a streaming inversion to
@@ -646,7 +665,7 @@ class _WritePipeline:
                     self._tm.metrics.histogram(
                         f"storage.{storage_label}.append_s."
                         f"{_chunk_size_bucket(nbytes)}"
-                    ).observe(time.monotonic() - t0)
+                    ).observe(append_s)
                 total += nbytes
                 self.progress.note_written(nbytes)
                 if not holds_full:
@@ -706,6 +725,18 @@ class _WritePipeline:
             return out
 
         return work
+
+    async def _storage_write(self, write_io: WriteIO) -> None:
+        """One whole-buffer plugin write, timed into the streaming
+        auto-select scorecard (the OFF-side evidence; the ON side feeds
+        from the per-chunk appends in ``_stream_one``)."""
+        t0 = time.monotonic()
+        await self.storage.write(write_io)
+        stream_select.note_whole(
+            _storage_label(self.storage),
+            memoryview(write_io.buf).nbytes,
+            time.monotonic() - t0,
+        )
 
     async def _write_one(self, path: str, buf) -> None:
         if knobs.is_checksums_enabled():
@@ -774,7 +805,7 @@ class _WritePipeline:
                         )
                     )
                     try:
-                        await self.storage.write(WriteIO(path=path, buf=buf))
+                        await self._storage_write(WriteIO(path=path, buf=buf))
                     except BaseException:
                         digest_task.cancel()
                         await asyncio.gather(
@@ -790,7 +821,7 @@ class _WritePipeline:
                 # plugin didn't — everything (non-native backends), or just
                 # the sha256 dedup digest.
                 write_io = WriteIO(path=path, buf=buf, want_digest=True)
-                await self.storage.write(write_io)
+                await self._storage_write(write_io)
                 digest = write_io.digest_out
                 if digest is None:
                     digest = await loop.run_in_executor(
@@ -859,7 +890,7 @@ class _WritePipeline:
                     if await self.storage.link_in(src, path):
                         self.bytes_deduped += my_size
                         return
-        await self.storage.write(WriteIO(path=path, buf=buf))
+        await self._storage_write(WriteIO(path=path, buf=buf))
 
     # ---------------------------------------------------------------- phases
 

@@ -602,6 +602,9 @@ class Snapshot:
                     raise
                 raise aborted from e
             finally:
+                from . import prepare_cache as prepare_cache_mod
+
+                prepare_cache_mod.release(plan.prepared_entry)
                 storage.sync_close(event_loop)
                 event_loop.close()
         finally:
@@ -694,6 +697,9 @@ class Snapshot:
             except BaseException:
                 # On planning/staging failure no PendingSnapshot exists to
                 # own cleanup; close here or the loop + plugin threads leak.
+                from . import prepare_cache as prepare_cache_mod
+
+                prepare_cache_mod.release(plan.prepared_entry)
                 storage.sync_close(event_loop)
                 event_loop.close()
                 raise
@@ -715,6 +721,7 @@ class Snapshot:
                 if job is not None
                 else None
             ),
+            prepared_entry=plan.prepared_entry,
         )
 
     @classmethod
@@ -781,15 +788,21 @@ class Snapshot:
             flattened.update(flat)
         tracker.mark("gather_keys_and_flatten")
 
-        # Fingerprint + cache probe only matter at world > 1 (preflight
+        # The plan-cache probe only matters at world > 1 (preflight
         # bypasses the collectives entirely at world 1 and plans are never
-        # stored there); skipping them keeps the single-process stall free
-        # of the per-leaf descriptor + sha256 cost.
-        if coord.get_world_size() > 1 and knobs.is_plan_cache_enabled():
+        # stored there), but the fingerprint itself is also the
+        # PREPARED-state cache's key (prepare_cache.py), which pays off at
+        # every world size — so compute it whenever either cache wants it;
+        # with both caches off the single-process stall stays free of the
+        # per-leaf descriptor + sha256 cost.
+        plan_cache_on = (
+            coord.get_world_size() > 1 and knobs.is_plan_cache_enabled()
+        )
+        if plan_cache_on or knobs.is_prepared_cache_enabled():
             fingerprint = compute_fingerprint(
                 flattened, coord.get_world_size(), replicated
             )
-            cached = probe_plan(coord, fingerprint)
+            cached = probe_plan(coord, fingerprint) if plan_cache_on else None
         else:
             fingerprint = ""
             cached = None
@@ -855,38 +868,130 @@ class Snapshot:
             set(flattened.keys()), plan.replicated_globs
         )
         prepare_timings: Dict[str, float] = {}
-        local_manifest, write_reqs = prepare_write(
-            flattened=flattened,
-            rank=rank,
-            world_size=world_size,
-            replicated_paths=replicated_paths,
-            is_async_snapshot=is_async_snapshot,
-            timings=prepare_timings,
-        )
-        manifest.update(local_manifest)
+        # Prepared-state cache (prepare_cache.py): on a fingerprint hit the
+        # prepare + partition + batching stages collapse into re-binding
+        # the new step's arrays into the cached stagers. SPMD safety at
+        # world > 1: per-rank hit/miss may diverge (an entry is busy while
+        # its pipeline drains), so the cache only engages when the miss
+        # path is collective-free — world 1, or a certified plan-cache hit
+        # (whose replayed assignment makes partition local). Incremental
+        # takes (base=) are excluded entirely: dedup-vs-base is a function
+        # of the step's BYTES, not its structure, and it relocates manifest
+        # entries to the base's files — artifacts a later take must never
+        # inherit. Slab paths must also stay fresh per take so the
+        # content-keyed incremental index is what dedups them.
+        from . import prepare_cache as prepare_cache_mod
+
+        prep_key = None
+        prepared = None
+        if (
+            plan.fingerprint
+            and plan.base is None
+            and knobs.is_prepared_cache_enabled()
+            and (world_size == 1 or plan.cache_hit)
+        ):
+            prep_key = (
+                plan.fingerprint,
+                type(storage).__name__,
+                is_async_snapshot,
+            )
+            prepared = prepare_cache_mod.acquire(coord, prep_key)
+            # Attached up front so every completion/failure path (sync
+            # finally, async error path, background commit finally)
+            # releases the busy latch even if this take aborts mid-phase.
+            plan.prepared_entry = prepared
+        assignment: Dict[str, int] = {}
+        if prepared is not None:
+            t0 = time.monotonic()
+            try:
+                local_manifest, write_reqs, assignment = prepared.rebind(
+                    flattened, world_size, is_async_snapshot, prepare_timings
+                )
+            except prepare_cache_mod.RebindMismatch:
+                # Should be unreachable (the fingerprint pins the
+                # structure); fall back to a full re-prepare.
+                logger.warning(
+                    "prepared-state rebind mismatch for %s; re-preparing",
+                    plan.path,
+                    exc_info=True,
+                )
+                prepare_cache_mod.release(prepared)
+                prepare_cache_mod.invalidate(coord, prep_key)
+                plan.prepared_entry = None
+                prepared = None
+            else:
+                prepare_timings["cache_hit"] = max(
+                    0.0,
+                    time.monotonic()
+                    - t0
+                    - prepare_timings.get("d2h_hint", 0.0),
+                )
+                manifest.update(local_manifest)
+        if prepared is None:
+            leaf_index: Optional[Dict[str, List]] = (
+                {} if prep_key is not None else None
+            )
+            local_manifest, write_reqs = prepare_write(
+                flattened=flattened,
+                rank=rank,
+                world_size=world_size,
+                replicated_paths=replicated_paths,
+                is_async_snapshot=is_async_snapshot,
+                timings=prepare_timings,
+                leaf_index=leaf_index,
+            )
+            manifest.update(local_manifest)
         _phase("prepare_write")
-        # Decompose the dominant stall phase into stage.prepare.* sub-spans
+
+        if prepared is None:
+            write_reqs, assignment = partition_write_reqs_with_assignment(
+                manifest,
+                write_reqs,
+                coord,
+                assignment=plan.cached.assignment if plan.cache_hit else None,
+            )
+
+            if knobs.is_batching_enabled():
+                from .batcher import batch_write_requests
+
+                entries = list(manifest.values())
+                _, write_reqs = batch_write_requests(entries, write_reqs)
+            if prep_key is not None:
+                # Store the post-partition post-batch artifacts for the
+                # next take's hit. O(leaves) bookkeeping — the artifacts
+                # already exist (this take is using them), so the cache
+                # never constructs anything on the critical path; the
+                # entry stays busy until this pipeline completes.
+                t0 = time.monotonic()
+                from .io_preparer import HostCapturedArray, classify
+
+                entry = prepare_cache_mod.PreparedTake(
+                    key=prep_key,
+                    leaf_kinds={
+                        p: (
+                            classify(v, world_size),
+                            isinstance(v, HostCapturedArray),
+                        )
+                        for p, v in flattened.items()
+                    },
+                    leaf_index=leaf_index or {},
+                    local_manifest=local_manifest,
+                    write_reqs=write_reqs,
+                    assignment=assignment,
+                )
+                prepare_cache_mod.store(coord, prep_key, entry)
+                plan.prepared_entry = entry
+                prepare_timings["cache_miss"] = time.monotonic() - t0
+        _phase("partition")
+        # Decompose the dominant stall phases into stage.prepare.* sub-spans
         # (d2h_hint: the defensive device fork + transfer hints;
-        # stager_construction: per-preparer planning; plan: the remainder).
+        # stager_construction: per-preparer planning; plan: the remainder;
+        # cache_hit / cache_miss: prepared-state rebind / store overhead).
         # Out-of-band notes: they ride the tracker's span list into
         # LAST_TAKE_PHASES and the persisted telemetry artifact without
         # moving the sequential phase boundary.
         for bucket, dur in sorted(prepare_timings.items()):
             tracker.note(f"stage.prepare.{bucket}", dur)
-
-        write_reqs, assignment = partition_write_reqs_with_assignment(
-            manifest,
-            write_reqs,
-            coord,
-            assignment=plan.cached.assignment if plan.cache_hit else None,
-        )
-
-        if knobs.is_batching_enabled():
-            from .batcher import batch_write_requests
-
-            entries = list(manifest.values())
-            _, write_reqs = batch_write_requests(entries, write_reqs)
-        _phase("partition")
 
         if is_async_snapshot and knobs.is_async_eager_d2h_enabled():
             # Post-partition, so DMAs start only for the bytes THIS rank
@@ -3570,9 +3675,13 @@ class PendingSnapshot:
         tm_prev: Optional["telemetry.Telemetry"] = None,
         phase_spans=None,
         catalog_info: Optional[Tuple[str, Optional[int], Optional[str], int]] = None,
+        prepared_entry=None,
     ) -> None:
         self.path = path
         self._coord = coord
+        # Prepared-state cache entry this take holds busy; released (array
+        # refs unbound) when the background pipeline completes.
+        self._prepared_entry = prepared_entry
         self._metadata = metadata
         self._pending_io_work = pending_io_work
         # (job, step, resolved base, chain_len) of a catalog-managed take;
@@ -3680,6 +3789,12 @@ class PendingSnapshot:
                 pass
             self._exc = e
         finally:
+            try:
+                from . import prepare_cache as prepare_cache_mod
+
+                prepare_cache_mod.release(self._prepared_entry)
+            except Exception:
+                pass
             try:
                 storage.sync_close(event_loop)
                 event_loop.close()

@@ -71,7 +71,12 @@ _WARNED_KEYSET_SIGS: "set" = set()
 # in-process caches from an older scheme must never satisfy a new build.
 # v3: dedup identities key off the v2 tree-digest root, whose grain
 # (TORCHSNAPSHOT_TPU_HASH_CHUNK_BYTES) joined the knob signature.
-_FINGERPRINT_VERSION = 3
+# v4: the fingerprint also keys the PREPARED-state cache (stagers + write
+# requests, prepare_cache.py), so every remaining prepare-affecting input
+# joined the knob signature: stream mode/grain/inflight (stream grain
+# shapes stream row ranges and the slab layout), device batching, the
+# async capture mode, and the defensive-copy switch.
+_FINGERPRINT_VERSION = 4
 
 def _is_jax_array(obj: Any) -> bool:
     import jax
@@ -145,6 +150,18 @@ def compute_fingerprint(
         # Resolved from env only (its default derives from the stream-chunk
         # env), so identical-env ranks resolve identically.
         knobs.get_hash_chunk_bytes(),
+        # Prepare-affecting inputs the PREPARED-state cache keys on (v4):
+        # the raw stream mode string (auto resolves per-host — same
+        # treatment as dedup_digests above), the stream grain/inflight
+        # (stream row ranges + slab chunk layout), device batching (slab
+        # stager choice), and the capture knobs (whether stagers were
+        # built against forked or caller-owned arrays).
+        knobs.get_stream_writes_env(),
+        knobs.get_stream_chunk_bytes(),
+        knobs.get_stream_inflight(),
+        knobs.is_device_batching_enabled(),
+        knobs.is_async_device_copy_enabled(),
+        knobs.get_async_capture_mode(),
     )
     payload = (
         _FINGERPRINT_VERSION,
@@ -212,6 +229,11 @@ class TakePlan:
     phase_tracker: Any = None
     # See PreflightResult.base_chain_len.
     base_chain_len: int = -1
+    # Set by _take_impl when this take acquired (hit) or stored (miss) a
+    # prepared-state cache entry (``prepare_cache.PreparedTake``); the
+    # pipeline-completion paths release it so the cached stagers drop
+    # their array references.
+    prepared_entry: Any = None
 
 
 def get_plan_cache(coord: Coordinator) -> "Dict[str, CachedPlan]":

@@ -114,6 +114,30 @@ def override_async_device_copy(enabled: bool):
     return _override_env(_ENV_ASYNC_DEVICE_COPY, "1" if enabled else "0")
 
 
+_ENV_ASYNC_CAPTURE = "TORCHSNAPSHOT_TPU_ASYNC_CAPTURE"
+
+
+def get_async_capture_mode() -> str:
+    """How ``async_take`` detaches device arrays from the training step:
+    ``fork`` (default) dispatches the defensive on-device copy, paying
+    transient HBM (and, on backends where the fork is unsupported, a
+    blocking host capture inside the stall); ``donate`` captures the
+    caller's immutable arrays ZERO-COPY — the SNIPPETS donation contract
+    inverted: instead of the snapshot ceding buffers to the step, the
+    caller promises not to donate (``donate_argnums``) or delete the
+    passed arrays until the pending snapshot commits. Under ``donate``
+    the capture cost of a steady-state take approaches zero. A violated
+    promise reads freed buffers — jax raises on use-after-donate, so the
+    failure is loud, but the take is lost; keep ``fork`` when the
+    training step donates checkpointed state."""
+    val = os.environ.get(_ENV_ASYNC_CAPTURE, "fork").lower()
+    return "donate" if val == "donate" else "fork"
+
+
+def override_async_capture(mode: str):
+    return _override_env(_ENV_ASYNC_CAPTURE, mode)
+
+
 def override_async_eager_d2h(enabled: bool):
     return _override_env(_ENV_ASYNC_EAGER_D2H, "1" if enabled else "0")
 
@@ -616,31 +640,119 @@ def override_plan_cache_size(value: int):
     return _override_env(_ENV_PLAN_CACHE_SIZE, str(value))
 
 
+_ENV_PREPARED_CACHE = "TORCHSNAPSHOT_TPU_PREPARED_CACHE"
+_ENV_PREPARED_CACHE_SIZE = "TORCHSNAPSHOT_TPU_PREPARED_CACHE_SIZE"
+
+
+def is_prepared_cache_enabled() -> bool:
+    """Cache the *prepared* take across steps, not just the plan: manifest
+    skeleton, constructed stagers/write requests (post-partition,
+    post-batch) and the replicated-write assignment, keyed by the take
+    fingerprint + storage scheme. On a hit, ``prepare_write`` reduces to
+    re-binding the new step's arrays into the cached stagers (the
+    ``stage.prepare.cache_hit`` span) — the steady-state stall stops paying
+    per-leaf classification/stager construction entirely. Strict
+    invalidation: any shape/sharding/knob/world/plugin change misses (the
+    fingerprint folds every prepare-affecting input), and a rebind that
+    detects drift falls back to the full miss path. See
+    docs/performance.md, "The steady-state take model"."""
+    return os.environ.get(_ENV_PREPARED_CACHE, "1") not in ("0", "false", "False")
+
+
+def get_prepared_cache_size() -> int:
+    """Max distinct (structure, scheme, sync/async) prepared states retained
+    per process (LRU). Cached stagers are UNBOUND between takes (no array
+    refs pinned), so an entry costs Python objects proportional to the leaf
+    count, not checkpoint bytes."""
+    return max(1, _get_int(_ENV_PREPARED_CACHE_SIZE, 4))
+
+
+def override_prepared_cache(enabled: bool):
+    return _override_env(_ENV_PREPARED_CACHE, "1" if enabled else "0")
+
+
+def override_prepared_cache_size(value: int):
+    return _override_env(_ENV_PREPARED_CACHE_SIZE, str(value))
+
+
 _ENV_STREAM_WRITES = "TORCHSNAPSHOT_TPU_STREAM_WRITES"
 _ENV_STREAM_CHUNK = "TORCHSNAPSHOT_TPU_STREAM_CHUNK_BYTES"
 _ENV_STREAM_INFLIGHT = "TORCHSNAPSHOT_TPU_STREAM_INFLIGHT"
 
-_DEFAULT_STREAM_CHUNK_BYTES = 32 * 1024 * 1024
+_DEFAULT_STREAM_CHUNK_BYTES = 64 * 1024 * 1024
+
+# Last auto-mode streaming resolution made by ``stream_select`` (process
+# global; None until a pipeline has resolved one). Lives here so the
+# boolean view below — read by code without a storage plugin in hand, e.g.
+# the stager's D2H pre-hint — tracks the decision the scheduler actually
+# made, instead of diverging from it.
+_STREAM_AUTO_RESOLVED: Optional[bool] = None
+
+
+def get_stream_writes_mode() -> str:
+    """``on`` | ``off`` | ``auto`` (the shipped default).
+
+    ``auto`` selects streaming per storage plugin only where it measurably
+    wins: ``stream_select.py`` keeps a per-plugin scorecard of streamed
+    append throughput vs whole-buffer write throughput (fed by the same
+    instrumentation as the ``storage.<plugin>.append_s.<bucket>``
+    histograms) and the write pipeline resolves the decision at graph-build
+    time — on hosts where per-chunk staging overhead inverts the A/B
+    (BENCH_r07: ON 0.21 GB/s vs OFF 0.36 GB/s on a 1-core host), auto
+    converges to OFF after the first measured takes instead of shipping the
+    inversion silently. With no evidence yet, auto streams (the optimistic
+    prior: streaming bounds peak RAM and wins wherever appends are not
+    overhead-dominated)."""
+    val = os.environ.get(_ENV_STREAM_WRITES, "auto").lower()
+    if val in ("auto", ""):
+        return "auto"
+    return "off" if val in ("0", "false", "off") else "on"
+
+
+def get_stream_writes_env() -> str:
+    """The RAW env string (fingerprint input): ``auto`` resolves per-host
+    from measured throughput, and identical-env ranks must produce identical
+    fingerprints — the same reason ``get_dedup_digests_env`` exists."""
+    return os.environ.get(_ENV_STREAM_WRITES, "auto")
+
+
+def note_stream_auto_resolution(enabled: Optional[bool]) -> None:
+    """Called by ``stream_select`` when an auto-mode decision is made (or
+    reset, with None), so ``is_stream_writes_enabled`` reflects it
+    process-wide."""
+    global _STREAM_AUTO_RESOLVED
+    _STREAM_AUTO_RESOLVED = enabled
 
 
 def is_stream_writes_enabled() -> bool:
     """Stream large write requests chunk-by-chunk through the scheduler.
 
-    When on (the default), a request whose stager supports incremental
-    staging (dim-0 chunkable raw/framed arrays, batched slabs) and whose
-    storage plugin supports appending writes is staged as a chunk stream:
-    the storage write for chunk *k* runs while chunk *k+1* is still in
+    When on, a request whose stager supports incremental staging (dim-0
+    chunkable raw/framed arrays, batched slabs) and whose storage plugin
+    supports appending writes is staged as a chunk stream: the storage
+    write for chunk *k* runs while chunk *k+1* is still in
     D2H/compression, and the memory budget is debited/credited per chunk —
     peak host RAM for one large array is ~``STREAM_CHUNK_BYTES x
     STREAM_INFLIGHT`` instead of its full size. Off = round-5 behavior
-    (stage the whole request, then write it)."""
-    return os.environ.get(_ENV_STREAM_WRITES, "1") not in ("0", "false", "False")
+    (stage the whole request, then write it). Under ``auto`` (the default)
+    this boolean view returns the last per-plugin decision the scheduler
+    resolved (see :func:`get_stream_writes_mode`), or True before any
+    resolution."""
+    mode = get_stream_writes_mode()
+    if mode == "auto":
+        return _STREAM_AUTO_RESOLVED if _STREAM_AUTO_RESOLVED is not None else True
+    return mode == "on"
 
 
 def get_stream_chunk_bytes() -> int:
-    """Target bytes per streamed chunk (default 32 MB). Smaller chunks
-    overlap sooner and bound RAM tighter but pay more per-append overhead;
-    keep well above the storage plugin's per-op latency·bandwidth product."""
+    """Target bytes per streamed chunk (default 64 MB). Smaller chunks
+    overlap sooner and bound RAM tighter but pay more per-append overhead
+    (BENCH_r07's inversion was overhead-dominated at the old 32 MB default
+    — per-chunk staging burned ~2s of CPU the whole-buffer path didn't);
+    keep well above the storage plugin's per-op latency·bandwidth product.
+    The hash-chunk grain defaults to this value, so changing it re-grids
+    dedup identities: objects taken under a different grain re-upload once
+    in an incremental chain."""
     return max(1, _get_int(_ENV_STREAM_CHUNK, _DEFAULT_STREAM_CHUNK_BYTES))
 
 
@@ -653,6 +765,12 @@ def get_stream_inflight() -> int:
 
 def override_stream_writes(enabled: bool):
     return _override_env(_ENV_STREAM_WRITES, "1" if enabled else "0")
+
+
+def override_stream_writes_mode(mode: str):
+    """Set the raw mode string (``on``/``off``/``auto``) — tests and the
+    bench's auto leg use this to exercise the auto path explicitly."""
+    return _override_env(_ENV_STREAM_WRITES, mode)
 
 
 def override_stream_chunk_bytes(value: int):
