@@ -1081,6 +1081,67 @@ def main() -> None:
                 f"({e!r}); recorded as absent"
             )
 
+        # ---- fleet-beacon overhead A/B: same interleaved protocol as the
+        # recorder A/B, with the fleet telemetry bus forced on vs off
+        # (world=1 over the in-process store, so "auto" would resolve
+        # off — force "1" to actually publish). The beacon path is
+        # rate-limited store writes off the drain's critical path, so
+        # acceptance is the same <=1% drain-wall budget. Fail-soft.
+        beacon_ab = None
+        try:
+            from torchsnapshot_tpu.telemetry import fleet as _fleet
+
+            bcn_reps = int(os.environ.get("BENCH_BEACON_AB_REPS", "5"))
+            bcn_walls = {"on": [], "off": []}
+
+            def run_beacon_rep(rep: int, enabled: bool) -> None:
+                label = "on" if enabled else "off"
+                sub = build_stream_slice(9000 + 2 * rep + (0 if enabled else 1))
+                with _knobs.override_fleet_telemetry(
+                    "1" if enabled else "0"
+                ), _knobs.override_fleet_beacon_s(0.1):
+                    _fleet.reset()  # re-arm the singleton under the knob
+                    pend = Snapshot.async_take(
+                        os.path.join(root, f"ckpt_bcn_{label}_{rep}"),
+                        {"model": StateDict(**sub)},
+                    )
+                    t0 = time.perf_counter()
+                    pend.wait()
+                    bcn_walls[label].append(time.perf_counter() - t0)
+                shutil.rmtree(
+                    os.path.join(root, f"ckpt_bcn_{label}_{rep}"),
+                    ignore_errors=True,
+                )
+
+            for rep in range(bcn_reps):
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                run_beacon_rep(rep, order[0])
+                run_beacon_rep(rep, order[1])
+            _fleet.reset()  # back to the ambient knob state
+            bcn_on = statistics.median(bcn_walls["on"])
+            bcn_off = statistics.median(bcn_walls["off"])
+            bcn_overhead = (
+                (bcn_on - bcn_off) / bcn_off if bcn_off > 0 else 0.0
+            )
+            beacon_ab = {
+                "reps": bcn_reps,
+                "on_drain_wall_s": round(bcn_on, 4),
+                "off_drain_wall_s": round(bcn_off, 4),
+                "overhead_frac": round(bcn_overhead, 4),
+                "within_budget": bool(bcn_overhead <= 0.01),
+                "on_all": [round(w, 4) for w in bcn_walls["on"]],
+                "off_all": [round(w, 4) for w in bcn_walls["off"]],
+            }
+            log(f"fleet beacon A/B: {beacon_ab}")
+            if not beacon_ab["within_budget"]:
+                log(
+                    "WARNING: fleet-beacon drain overhead "
+                    f"{bcn_overhead * 100:.2f}% exceeds the 1% budget on "
+                    "this host"
+                )
+        except Exception as e:  # fail-soft by design
+            log(f"WARNING: beacon A/B leg failed ({e!r}); recorded as absent")
+
         # ---- elastic reshard matrix (benchmarks/reshard): N→M restores
         # across mesh shapes / axis orders / replication, bit-exact, with
         # origin bytes accounted against the theoretical overlap bytes
@@ -1172,6 +1233,7 @@ def main() -> None:
                         "restore_bit_exact": ok,
                         "restore": restore_record,
                         "recorder_ab": recorder_ab,
+                        "beacon_ab": beacon_ab,
                         "job_timeline": job_timeline,
                         "reshard": reshard_record,
                         "telemetry": telemetry_summary,

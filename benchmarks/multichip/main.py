@@ -46,6 +46,7 @@ def child(n_devices: int, total_mb: float, out_path: str) -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.telemetry import aggregate, fleet
     from torchsnapshot_tpu.utils import knobs
 
     devices = jax.devices()[:n_devices]
@@ -66,9 +67,16 @@ def child(n_devices: int, total_mb: float, out_path: str) -> None:
     try:
         # Per-device transfer lanes + per-shard write_streams: the drain
         # should hold one lane and one storage stream busy per device.
+        # Fleet telemetry forced on for the measured drain (single-process
+        # cell, so "auto" resolves off): the cell record carries the
+        # beacon rollup — engine high-water mark, final phase — beside the
+        # throughput numbers.
         with knobs.override_d2h_lanes(max(4, n_devices)), (
             knobs.override_stream_writes(True)
+        ), knobs.override_fleet_telemetry("1"), (
+            knobs.override_fleet_beacon_s(0.05)
         ):
+            fleet.reset()
             # Warmup absorbs compile/native-engine costs outside the
             # measured drain.
             Snapshot.take(os.path.join(root, "warm"), {"m": StateDict(x=arr)})
@@ -80,6 +88,23 @@ def child(n_devices: int, total_mb: float, out_path: str) -> None:
             t0 = time.perf_counter()
             pending.wait()
             drain_s = time.perf_counter() - t0
+            fleet_summary = None
+            try:
+                bus = fleet.get_bus()
+                if bus is not None:
+                    bus.publish(force=True)
+                    view = aggregate.fleet_view(bus.read_beacons())
+                    mine = (view.get("per_rank") or {}).get(0) or {}
+                    fleet_summary = {
+                        "ranks": view.get("ranks"),
+                        "engine": mine.get("engine"),
+                        "budget_hwm": mine.get("budget_hwm"),
+                        "phase": mine.get("phase"),
+                        "anomalies": mine.get("anomalies"),
+                    }
+            except Exception as e:  # fail-soft by design
+                fleet_summary = {"error": repr(e)}
+        fleet.reset()  # back to the ambient knob state
         ds = pending.drain_stats
         rec = {
             "devices": n_devices,
@@ -90,6 +115,7 @@ def child(n_devices: int, total_mb: float, out_path: str) -> None:
             "stage_busy_s": round(ds.get("stage_busy_s", 0.0), 3),
             "io_busy_s": round(ds.get("io_busy_s", 0.0), 3),
             "overlap_s": round(ds.get("overlap_s", 0.0), 3),
+            "fleet": fleet_summary,
         }
         with open(out_path, "w") as f:
             json.dump(rec, f)

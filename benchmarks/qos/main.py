@@ -264,6 +264,40 @@ def engine_side(qos_on: bool, rep: int) -> dict:
     return rec
 
 
+def _fleet_summary() -> dict:
+    """Read back this process's own beacon and compress it to the fields
+    the artifact keeps (fail-soft: absent beats a sunk benchmark)."""
+    from torchsnapshot_tpu.telemetry import aggregate, fleet
+
+    bus = fleet.get_bus()
+    if bus is None:
+        return {"enabled": False}
+    bus.publish(force=True)
+    view = aggregate.fleet_view(bus.read_beacons())
+    per_rank = view.get("per_rank") or {}
+    return {
+        "enabled": True,
+        "ranks": view.get("ranks"),
+        "world_size": view.get("world_size"),
+        "edges": view.get("edges"),
+        "per_rank": {
+            str(r): {
+                k: b.get(k)
+                for k in (
+                    "op",
+                    "phase",
+                    "engine",
+                    "engine_paused",
+                    "budget_hwm",
+                    "qos_demand",
+                    "anomalies",
+                )
+            }
+            for r, b in per_rank.items()
+        },
+    }
+
+
 def _p99(samples):
     ordered = sorted(samples)
     idx = min(len(ordered) - 1, int(round(0.99 * (len(ordered) - 1))))
@@ -275,6 +309,7 @@ def e2e_leg(root: str) -> dict:
     both ops complete, restores bit-exact, drain verifies clean; overlap /
     preemption counters recorded for whatever this host's timing produced."""
     from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.telemetry import fleet
     from torchsnapshot_tpu.utils import knobs
 
     rng = np.random.default_rng(7)
@@ -289,9 +324,16 @@ def e2e_leg(root: str) -> dict:
             for i in range(max(2, BG_MB))
         }
     )
+    # Fleet telemetry forced on (world=1 over the in-process store, so
+    # "auto" resolves off): the artifact embeds the fleet view so the QoS
+    # rollup carries the same beacon rollup operators see live.
+    fleet_summary = None
     with knobs.override_qos_poll_s(0.005), knobs.override_stream_chunk_bytes(
         1024 * 1024
+    ), knobs.override_fleet_telemetry("1"), knobs.override_fleet_beacon_s(
+        0.05
     ):
+        fleet.reset()
         pending = Snapshot.async_take(
             os.path.join(root, "bg"), {"m": bg_state}, qos="background"
         )
@@ -304,7 +346,12 @@ def e2e_leg(root: str) -> dict:
             Snapshot(fg_path).restore({"m": restored}, qos="foreground")
             walls.append(round(time.perf_counter() - t0, 4))
             assert np.array_equal(restored["v"], fg_state["v"])
+        try:
+            fleet_summary = _fleet_summary()
+        except Exception as e:  # fail-soft by design
+            fleet_summary = {"enabled": True, "error": repr(e)}
         pending.wait()
+    fleet.reset()  # back to the ambient knob state
     eng = pending._pending_io_work._pipeline._engine
     assert Snapshot(os.path.join(root, "bg")).verify() == {}
     return {
@@ -312,6 +359,7 @@ def e2e_leg(root: str) -> dict:
         "restores_overlapping_drain": overlapped,
         "drain_preemptions": eng.preemptions,
         "drain_preempted_wait_s": round(eng.preempted_wait_s, 3),
+        "fleet": fleet_summary,
     }
 
 

@@ -77,6 +77,10 @@ _IMPL_EXEMPT_SUFFIXES = (
     "parallel/coordinator.py",
     "parallel/store.py",
     "collective_tracer.py",
+    # The fleet telemetry bus is diagnostics-plane store traffic by design:
+    # per-rank beacon keys are written asymmetrically (each rank its own,
+    # readers read all) — the same sanctioned asymmetry as report_error.
+    "telemetry/fleet.py",
 )
 
 _COLLECTIVE_ATTRS = {

@@ -1,0 +1,800 @@
+"""Fleet telemetry bus tests: beacon schema + publish/read over the
+in-process store, wait-edge bookkeeping, store-occupancy GC, the off-mode
+zero-allocation contract, the fleet-level health detectors, the live-view
+merge/format, beacon Perfetto export, restore rollout records, and the
+``monitor --fleet`` / ``fleet-health`` CLI exit contracts.
+
+Multiprocess legs (straggler attribution, beacon chaos) live at the bottom
+and run under both runtime sanitizers (effect ledger + collective lockstep),
+matching the rest of the multiprocess suite.
+"""
+
+import contextlib
+import io
+import json
+import logging
+import os
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.telemetry import (
+    aggregate,
+    export,
+    fleet,
+    health,
+    steprecord,
+)
+from torchsnapshot_tpu.utils import knobs
+
+
+class _FakeEngine:
+    def __init__(self) -> None:
+        self.calls = 0
+
+    def introspect(self):
+        self.calls += 1
+        return {
+            "engine": "fake",
+            "rank": 0,
+            "paused": False,
+            "budget_hwm": 123,
+            "bytes_done": 456,
+        }
+
+
+@pytest.fixture
+def bus():
+    """A live bus over the in-process (LocalStore) coordinator, knob forced
+    on ("auto" resolves off for a solo process)."""
+    with knobs.override_fleet_telemetry("1"), knobs.override_fleet_beacon_s(
+        0.05
+    ):
+        fleet.reset()
+        b = fleet.get_bus()
+        assert b is not None
+        yield b
+    fleet.reset()
+
+
+# ---------------------------------------------------------------------------
+# Bus: publish / read / schema
+# ---------------------------------------------------------------------------
+
+
+def test_bus_publish_and_read(bus) -> None:
+    bus.note_op("take")  # op boundaries force a publish
+    bus.note_phase("drain")
+    eng = _FakeEngine()
+    bus.sample_engine(eng)
+    bus.publish(force=True)
+    beacons = bus.read_beacons()
+    assert set(beacons) == {0}
+    b = beacons[0]
+    assert b["schema_version"] == fleet.BEACON_SCHEMA_VERSION
+    assert b["rank"] == 0 and b["world_size"] == 1
+    assert b["op"] == "take" and b["phase"] == "drain"
+    assert b["engine"]["engine"] == "fake"
+    assert b["pid"] == os.getpid()
+    assert isinstance(b["seq"], int) and b["seq"] >= 1
+    # note_op(None) is the idle "last word" (the dead-beacon fence).
+    bus.note_op(None)
+    b = bus.read_beacons()[0]
+    assert b["op"] is None and b["phase"] is None
+
+
+def test_publish_rate_limited_and_forced(bus) -> None:
+    assert bus.publish(force=True)
+    n = bus.publishes
+    assert not bus.publish()  # inside the interval: skipped
+    assert bus.publishes == n
+    assert bus.publish(force=True)
+    assert bus.publishes == n + 1
+
+
+def test_parse_beacon_rejects_foreign_payloads() -> None:
+    with pytest.raises(ValueError):
+        fleet.parse_beacon(b"\xff not json")
+    with pytest.raises(ValueError):
+        fleet.parse_beacon(b"[1, 2]")
+    with pytest.raises(ValueError):
+        fleet.parse_beacon(json.dumps({"rank": 0}).encode())  # no version
+    newer = {"schema_version": fleet.BEACON_SCHEMA_VERSION + 1, "rank": 0}
+    with pytest.raises(ValueError):
+        fleet.parse_beacon(json.dumps(newer).encode())
+    with pytest.raises(ValueError):
+        fleet.parse_beacon(json.dumps({"schema_version": 1}).encode())
+    ok = {"schema_version": fleet.BEACON_SCHEMA_VERSION, "rank": 3}
+    assert fleet.parse_beacon(json.dumps(ok).encode())["rank"] == 3
+
+
+def test_read_beacons_skips_unparseable_rank(bus) -> None:
+    bus.publish(force=True)
+    bus._store.set(fleet.beacon_key(1), b"not a beacon")
+    beacons = fleet.read_beacons(bus._store, world_size=2)
+    assert set(beacons) == {0}  # rank 1 degraded, rank 0 intact
+
+
+# ---------------------------------------------------------------------------
+# Wait edges
+# ---------------------------------------------------------------------------
+
+
+def test_blocked_edges_age_and_replace(bus) -> None:
+    bus.note_blocked("barrier.arrive:c", [1, "store"])
+    time.sleep(0.03)
+    # Replacing the site's set preserves first-blocked time for peers that
+    # stay — age measures the whole wait, not the last refresh.
+    bus.note_blocked("barrier.arrive:c", [1])
+    edges = bus.blocked_edges()
+    assert len(edges) == 1
+    peer, site, age = edges[0]
+    assert peer == 1 and site == "barrier.arrive:c" and age >= 0.03
+    bus.publish(force=True)
+    b = bus.read_beacons()[0]
+    assert b["blocked_on"] and b["blocked_on"][0][0] == 1
+    bus.clear_blocked("barrier.arrive:c")
+    assert bus.blocked_edges() == []
+    bus.publish(force=True)
+    assert bus.read_beacons()[0]["blocked_on"] == []
+
+
+def test_blocked_empty_peers_clears_site(bus) -> None:
+    bus.note_blocked("s", [2])
+    bus.note_blocked("s", [])
+    assert bus.blocked_edges() == []
+
+
+def test_blocked_site_count_bounded(bus) -> None:
+    for i in range(fleet._MAX_BLOCKED_SITES + 8):
+        bus.note_blocked(f"site{i}", [1])
+    assert len(bus.blocked_edges()) == fleet._MAX_BLOCKED_SITES
+
+
+def test_blocked_detail_attaches_peer_phase(bus) -> None:
+    peer_beacon = {
+        "schema_version": fleet.BEACON_SCHEMA_VERSION,
+        "rank": 1,
+        "ts_unix": time.time(),
+        "op": "take",
+        "phase": "drain",
+    }
+    bus._store.set(fleet.beacon_key(1), json.dumps(peer_beacon).encode())
+    bus.world_size = 2  # the probe range covers the fabricated peer
+    bus.note_blocked("barrier.arrive:c", [1])
+    detail = bus.blocked_detail()
+    assert detail[0]["peer"] == 1
+    assert detail[0]["peer_phase"] == "drain"
+    assert bus.peer_phase(1) == "drain"
+
+
+# ---------------------------------------------------------------------------
+# Store occupancy + GC
+# ---------------------------------------------------------------------------
+
+
+def test_gc_bounds_store_occupancy(bus) -> None:
+    # Many publishes, ONE key: per-rank beacons overwrite in place.
+    for _ in range(10):
+        bus.publish(force=True)
+    key = fleet.beacon_key(bus.rank)
+    assert bus._store.try_get(key) is not None
+    coord = bus._coord
+    posted_before = len(coord._posted)
+    bus.gc()
+    assert len(coord._posted) == posted_before + 1
+    bus.gc()  # same publish generation: deduped, _posted must not grow
+    assert len(coord._posted) == posted_before + 1
+    # world_size==1 collectives early-return, so drive the generation fence
+    # by hand: a *later* full-world barrier proves everyone is past the key.
+    coord._generation += 1
+    coord.note_external_barrier()
+    coord._gc_posted()
+    assert bus._store.try_get(key) is None
+
+
+# ---------------------------------------------------------------------------
+# Off mode: the recorder's zero-allocation contract, same bar
+# ---------------------------------------------------------------------------
+
+
+def test_off_mode_feed_sites_allocate_nothing() -> None:
+    try:
+        with knobs.override_fleet_telemetry("0"):
+            fleet.reset()
+            eng = _FakeEngine()
+            # Warm-up: lazy _init plus CPython inline-cache settling.
+            for _ in range(512):
+                fleet.note_phase("warm")
+                fleet.sample_engine(eng)
+                fleet.note_blocked("s", [1])
+                fleet.heartbeat()
+            loop = [None] * 2000
+            tracemalloc.start()
+            it = iter(loop)
+            before, _ = tracemalloc.get_traced_memory()
+            for _ in it:
+                fleet.note_phase("k")
+                fleet.sample_engine(eng)
+                fleet.note_blocked("s", [1])
+                fleet.heartbeat()
+            after, _ = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            assert after - before < 1024, (
+                f"off-mode feed allocated {after - before} bytes over 2000 "
+                "calls"
+            )
+            assert eng.calls == 0  # introspect never touched
+    finally:
+        tracemalloc.stop()
+        fleet.reset()
+
+
+def test_auto_mode_off_for_solo_process() -> None:
+    # No coordinator store configured: "auto" must resolve to no bus.
+    with knobs.override_fleet_telemetry("auto"):
+        fleet.reset()
+        assert fleet.get_bus() is None
+        assert not fleet.enabled()
+    fleet.reset()
+
+
+# ---------------------------------------------------------------------------
+# Fleet health detectors (synthetic beacons)
+# ---------------------------------------------------------------------------
+
+
+def _beacon(rank, ws=2, op="take", phase="drain", age=0.0, blocked=None,
+            now=1000.0, interval=0.5):
+    return {
+        "schema_version": fleet.BEACON_SCHEMA_VERSION,
+        "rank": rank,
+        "world_size": ws,
+        "pid": 100 + rank,
+        "seq": 5,
+        "ts_unix": now - age,
+        "interval_s": interval,
+        "op": op,
+        "phase": phase,
+        "engine": None,
+        "anomalies": {},
+        "blocked_on": list(blocked or []),
+        "progress": None,
+        "qos": None,
+    }
+
+
+def test_detect_dead_beacon_mid_op() -> None:
+    beacons = {
+        0: _beacon(0, age=0.1),
+        1: _beacon(1, age=10.0),  # stale fence = max(3*0.5, 2.0) = 2.0
+    }
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    kinds = {(e["kind"], e.get("rank")) for e in events}
+    assert ("dead_beacon", 1) in kinds
+    assert not any(e.get("rank") == 0 for e in events)
+    # An idle (op=None) stale beacon is a finished process, not a death.
+    beacons[1] = _beacon(1, age=10.0, op=None, phase=None)
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    assert not any(e["kind"] == "dead_beacon" for e in events)
+
+
+def test_detect_dead_beacon_missing_while_waited_on() -> None:
+    beacons = {0: _beacon(0, blocked=[[1, "barrier.arrive:c", 3.0]])}
+    events = health.detect_fleet_anomalies(
+        beacons, 0.5, world_size=2, now=1000.0
+    )
+    dead = [e for e in events if e["kind"] == "dead_beacon"]
+    assert dead and dead[0]["rank"] == 1
+    assert "no beacon at all" in dead[0]["detail"]
+
+
+def test_detect_straggler_names_waiters_and_phase() -> None:
+    beacons = {
+        0: _beacon(0, blocked=[[1, "barrier.arrive:c", 4.0]]),
+        1: _beacon(1, phase="d2h"),
+    }
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    stragglers = [e for e in events if e["kind"] == "straggler"]
+    assert len(stragglers) == 1
+    ev = stragglers[0]
+    assert ev["rank"] == 1
+    assert "blocked on rank 1" in ev["detail"]
+    assert "d2h" in ev["detail"]
+
+
+def test_detect_straggler_store_wait_distinguished() -> None:
+    # "rank 1 is slow" vs "everyone waits on rank 1 which waits on the
+    # store" — the detail must carry the second clause.
+    beacons = {
+        0: _beacon(0, blocked=[[1, "barrier.arrive:c", 4.0]]),
+        1: _beacon(1, blocked=[["store", "bcast.obtain:3", 4.0]]),
+    }
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    ev = next(e for e in events if e["kind"] == "straggler")
+    assert "waits on the store" in ev["detail"]
+
+
+def test_detect_wait_cycle() -> None:
+    beacons = {
+        0: _beacon(0, blocked=[[1, "swarm.chunk", 3.0]]),
+        1: _beacon(1, blocked=[[0, "swarm.chunk", 3.0]]),
+    }
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    cycles = [e for e in events if e["kind"] == "wait_cycle"]
+    assert len(cycles) == 1
+    assert "->" in cycles[0]["detail"]
+    # Both ranks have outgoing edges, so neither is a plain straggler.
+    assert not any(e["kind"] == "straggler" for e in events)
+
+
+def test_detect_paused_starvation() -> None:
+    beacons = {
+        0: _beacon(0, blocked=[["class:HIGH", "qos.pause", 45.0]]),
+        1: _beacon(1),
+    }
+    events = health.detect_fleet_anomalies(beacons, 0.5, now=1000.0)
+    ev = next(e for e in events if e["kind"] == "paused_starvation")
+    assert ev["rank"] == 0 and "qos.pause" in ev["detail"]
+
+
+def test_detect_clean_fleet_flags_nothing() -> None:
+    beacons = {0: _beacon(0, age=0.1), 1: _beacon(1, age=0.2)}
+    assert health.detect_fleet_anomalies(beacons, 0.5, now=1000.0) == []
+    assert health.detect_fleet_anomalies({}, 0.5, now=1000.0) == []
+
+
+# ---------------------------------------------------------------------------
+# Fleet view + formatting
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_view_and_format() -> None:
+    b0 = _beacon(0, ws=3, blocked=[[2, "barrier.arrive:c", 5.0]])
+    b0["progress"] = {
+        "bytes_written": 2 * 10**9,
+        "bytes_total": 4 * 10**9,
+        "requests_done": 1,
+        "requests_total": 2,
+        "bytes_per_s_ewma": 1.5e8,
+        "eta_s": 13.3,
+    }
+    b0["engine"] = {"engine": "write", "paused": True, "budget_hwm": 7}
+    b2 = _beacon(2, ws=3, phase="d2h")
+    view = aggregate.fleet_view({0: b0, 2: b2}, now=1000.0)
+    assert view["world_size"] == 3
+    assert view["ranks"] == [0, 2]
+    assert view["missing_ranks"] == [1]
+    assert view["per_rank"][0]["engine_paused"] is True
+    assert view["per_rank"][0]["bytes_written"] == 2 * 10**9
+    assert view["edges"] == [
+        {"rank": 0, "peer": 2, "site": "barrier.arrive:c", "age_s": 5.0}
+    ]
+    text = "\n".join(aggregate.format_fleet(view))
+    assert "world_size=3" in text
+    assert "(no beacon)" in text
+    assert "waiting on:" in text
+    assert "last phase: d2h" in text
+    assert "paused" in text
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: counter tracks + beacon timelines
+# ---------------------------------------------------------------------------
+
+
+def test_counter_tracks_ride_alongside_spans() -> None:
+    from torchsnapshot_tpu import telemetry
+
+    tm = telemetry.Telemetry()
+    with tm.span("phase.drain"):
+        pass
+    anchor = time.time() - time.monotonic()
+    t = anchor + tm.t0
+    samples = [
+        {"kind": "engine.sample", "ts": t + 0.1, "engine": "write",
+         "bytes_done": 0, "budget_hwm": 4},
+        {"kind": "engine.sample", "ts": t + 0.6, "engine": "write",
+         "bytes_done": 5 * 10**8, "budget_hwm": 6},
+        {"kind": "other.event", "ts": t + 0.2},  # non-sample: ignored
+    ]
+    trace = export.to_chrome_trace(tm, recorder_samples=samples)
+    counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    names = {e["name"] for e in counters}
+    assert names == {"write.bytes_per_s", "write.budget_hwm"}
+    rates = [
+        e["args"]["bytes_per_s"]
+        for e in counters
+        if e["name"] == "write.bytes_per_s"
+    ]
+    assert rates[0] == 0.0 and rates[1] == pytest.approx(1e9, rel=0.02)
+    # Counter events are invisible to the span round-trip contract.
+    spans = export.spans_from_chrome_trace(trace)
+    assert [s.name for s in spans] == ["phase.drain"]
+    # Without samples the trace is unchanged from the classic shape.
+    assert not any(
+        e.get("ph") == "C"
+        for e in export.to_chrome_trace(tm)["traceEvents"]
+    )
+
+
+def test_fleet_beacon_trace_layout(tmp_path) -> None:
+    now = time.time()
+    b1 = _beacon(0, now=now, age=1.0, phase="d2h")
+    b1["seq"] = 1
+    b1["progress"] = {"bytes_per_s_ewma": 100.0}
+    b2 = _beacon(0, now=now, age=0.0, phase="drain",
+                 blocked=[[1, "barrier.arrive:c", 0.5]])
+    b2["seq"] = 2
+    peer = _beacon(1, now=now, age=0.5, phase="d2h")
+    history = [b1, b2, dict(b2), peer, {"garbage": True}]
+    trace = export.fleet_beacon_trace(history)
+    events = trace["traceEvents"]
+    # pid = rank: the merged-trace per-rank process layout.
+    assert {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M"
+    } == {0: "rank 0", 1: "rank 1"}
+    # The duplicated (rank, pid, seq) read is fenced out.
+    assert trace["otherData"]["beacons"] == 4
+    blocked = [
+        e for e in events
+        if e["name"] == "blocked_peers" and e["pid"] == 0
+    ]
+    assert [e["args"]["blocked_peers"] for e in blocked] == [0, 1]
+    phases = [e["name"] for e in events if e.get("ph") == "i"]
+    assert phases.count("d2h") == 2 and "drain" in phases
+    # Atomic object writer round-trips through json.
+    out = tmp_path / "beacons.json"
+    export.write_trace_obj(trace, str(out))
+    assert json.loads(out.read_text())["otherData"]["beacons"] == 4
+    assert export.spans_from_chrome_trace(trace) == []
+
+
+# ---------------------------------------------------------------------------
+# Rollout (restore-side) step records
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_record_roundtrip() -> None:
+    rec = steprecord.build_rollout_record(
+        job="llama-rollouts",
+        step=12,
+        name="step_00012",
+        rank=1,
+        world_size=4,
+        wall_s=3.25,
+        attribution={"origin_bytes": 10, "peer_bytes": 20, "cache_bytes": 5},
+        mode="swarm",
+    )
+    parsed = steprecord.parse_rollout_record(
+        steprecord.dumps_rollout_record(rec)
+    )
+    assert parsed == rec
+    assert parsed["bytes"] == {"origin": 10, "peer": 20, "cache": 5}
+    with pytest.raises(ValueError):
+        steprecord.parse_rollout_record(b"junk")
+    with pytest.raises(ValueError):
+        steprecord.parse_rollout_record(json.dumps({"kind": "rollout"}).encode())
+
+
+def test_catalog_rollout_append_and_load(tmp_path) -> None:
+    from torchsnapshot_tpu import catalog as catalog_mod
+
+    bucket = str(tmp_path)
+    with catalog_mod.Catalog(bucket) as cat:
+        for rank in (1, 0):  # out of order on purpose
+            cat.append_rollout_record(
+                steprecord.build_rollout_record(
+                    job="j", step=3, name="step_00003", rank=rank,
+                    world_size=2, wall_s=1.0 + rank,
+                )
+            )
+        cat.append_rollout_record(
+            steprecord.build_rollout_record(
+                job="other", step=1, name="s", rank=0, world_size=2,
+                wall_s=0.5,
+            )
+        )
+        recs = cat.load_rollout_telemetry(job="j")
+    # Per-rank records NOT merged (skew is the signal), sorted by step/rank.
+    assert [(r["step"], r["rank"]) for r in recs] == [(3, 0), (3, 1)]
+    assert all(r["job"] == "j" for r in recs)
+
+
+def test_restore_with_job_appends_rollout_record(tmp_path) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import catalog as catalog_mod
+
+    bucket = tmp_path / "bucket"
+    path = str(bucket / "step_00007")
+    state = StateDict(w=np.arange(32, dtype=np.float32))
+    with knobs.override_catalog(True), knobs.override_step_telemetry(True):
+        Snapshot.take(path, {"m": state})
+        tgt = StateDict(w=np.zeros(32, dtype=np.float32))
+        Snapshot(path).restore({"m": tgt}, job="serve-job")
+        assert np.array_equal(tgt["w"], state["w"])
+        with catalog_mod.Catalog(str(bucket)) as cat:
+            recs = cat.load_rollout_telemetry(job="serve-job")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["name"] == "step_00007"
+    assert rec["step"] == 7  # inferred from the snapshot name's digits
+    assert rec["mode"] == "direct"
+    assert rec["wall_s"] > 0
+    # Without job=, nothing is appended.
+    with knobs.override_catalog(True), knobs.override_step_telemetry(True):
+        Snapshot(path).restore(
+            {"m": StateDict(w=np.zeros(32, dtype=np.float32))}
+        )
+        with catalog_mod.Catalog(str(bucket)) as cat:
+            assert len(cat.load_rollout_telemetry(job="serve-job")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: monitor staleness, monitor --fleet, fleet-health
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(argv):
+    from torchsnapshot_tpu.__main__ import main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(argv)
+    return rc, out.getvalue()
+
+
+def test_monitor_stale_dump_flag_and_expect_live(tmp_path) -> None:
+    dump = {
+        "pid": 1234,
+        "capacity": 16,
+        "dropped": 0,
+        "written_unix": time.time() - 100.0,
+        "samples": [],
+    }
+    path = str(tmp_path / "dump.json")
+    with open(path, "w") as f:
+        json.dump(dump, f)
+    rc, out = _run_cli(["monitor", path])
+    assert rc == 0 and "STALE" in out
+    rc, _ = _run_cli(["monitor", path, "--expect-live"])
+    assert rc == 1
+    rc, _ = _run_cli(["monitor", path, "--expect-live", "--json"])
+    assert rc == 1
+    dump["written_unix"] = time.time()
+    with open(path, "w") as f:
+        json.dump(dump, f)
+    rc, out = _run_cli(["monitor", path, "--expect-live"])
+    assert rc == 0 and "STALE" not in out
+
+
+@pytest.fixture
+def live_store():
+    """A real TCPStore server hosting fabricated beacons — what an operator
+    points ``monitor --fleet`` / ``fleet-health`` at."""
+    from torchsnapshot_tpu.parallel.store import TCPStore
+
+    server = TCPStore("127.0.0.1", 0, is_server=True)
+    try:
+        yield server, f"127.0.0.1:{server.port}"
+    finally:
+        server.shutdown()
+
+
+def _post(store, beacon) -> None:
+    store.set(fleet.beacon_key(beacon["rank"]), json.dumps(beacon).encode())
+
+
+def test_monitor_fleet_renders_live_table(live_store, tmp_path) -> None:
+    server, addr = live_store
+    now = time.time()
+    _post(server, _beacon(0, now=now, age=0.0,
+                          blocked=[[1, "barrier.arrive:c", 2.0]]))
+    _post(server, _beacon(1, now=now, age=0.1, phase="d2h"))
+    rc, out = _run_cli(["monitor", "--fleet", addr])
+    assert rc == 0
+    assert "world_size=2" in out
+    assert "barrier.arrive:c" in out and "last phase: d2h" in out
+    trace_path = str(tmp_path / "fleet.json")
+    rc, out = _run_cli(
+        ["monitor", "--fleet", addr, "--watch", "2", "--trace", trace_path]
+    )
+    assert rc == 0
+    trace = json.loads(open(trace_path).read())
+    assert trace["otherData"]["producer"] == "torchsnapshot_tpu.telemetry.fleet"
+    assert any(e.get("ph") == "M" for e in trace["traceEvents"])
+    rc, out = _run_cli(["monitor", "--fleet", addr, "--json"])
+    assert rc == 0 and json.loads(out)["world_size"] == 2
+
+
+def test_fleet_health_exit_codes(live_store) -> None:
+    server, addr = live_store
+    now = time.time()
+    _post(server, _beacon(0, now=now, age=0.0, op=None, phase=None))
+    _post(server, _beacon(1, now=now, age=0.0, op=None, phase=None))
+    rc, out = _run_cli(["fleet-health", addr])
+    assert rc == 0 and "fleet healthy" in out
+    # A straggler flips the verdict to 1 (timeline's contract).
+    _post(server, _beacon(0, now=now, age=0.0,
+                          blocked=[[1, "barrier.arrive:c", 4.0]]))
+    _post(server, _beacon(1, now=now, age=0.1, phase="d2h"))
+    rc, out = _run_cli(["fleet-health", addr])
+    assert rc == 1 and "straggler" in out and "rank 1" in out.replace(
+        "rank=1", "rank 1"
+    )
+    rc, out = _run_cli(["fleet-health", addr, "--json"])
+    assert rc == 1
+    payload = json.loads(out)
+    assert any(a["kind"] == "straggler" for a in payload["anomalies"])
+    # A malformed address is operator error: exit 2 via the global handler.
+    assert _run_cli(["fleet-health", "not-an-address"])[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess legs (both runtime sanitizers on, like the rest of the
+# multiprocess suite)
+# ---------------------------------------------------------------------------
+
+
+def _worker_straggler_named(rank: int, world_size: int, shared: str) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.__main__ import main as cli_main
+    from torchsnapshot_tpu.telemetry import fleet as fleet_mod
+
+    store_addr = knobs.get_store_addr()
+    records: list = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logging.getLogger("torchsnapshot_tpu.telemetry.progress").addHandler(
+        _Capture()
+    )
+
+    state = StateDict(v=np.full((1 << 16,), rank, dtype=np.float32))
+    path = os.path.join(shared, "ckpt")
+    with knobs.override_debug_ledger(True), knobs.override_debug_collectives(
+        True
+    ), knobs.override_fleet_telemetry("1"), knobs.override_fleet_beacon_s(
+        0.1
+    ), knobs.override_stall_warn_s(0.5), knobs.override_barrier_timeout_s(
+        60.0
+    ):
+        fleet_mod.reset()
+        if rank == 1:
+            # The injected straggler: every object write stalls 8 s, so rank 0
+            # reaches the commit barrier long before rank 1 does. Both ranks
+            # must use async_take — the commit barrier id differs between the
+            # sync and async paths, so mixing them would never rendezvous.
+            with knobs.override_faults("op=write,kind=stall,secs=8.0"):
+                Snapshot.async_take(path, {"m": state}).wait()
+        else:
+            pend = Snapshot.async_take(path, {"m": state})
+            # The commit barrier runs in the background thread; this main
+            # thread watches the fleet while rank 0 waits on rank 1.
+            deadline = time.monotonic() + 30.0
+            named = False
+            while time.monotonic() < deadline and not named:
+                try:
+                    store = fleet_mod.connect(store_addr)
+                    beacons = fleet_mod.read_beacons(store)
+                except Exception:
+                    time.sleep(0.2)
+                    continue
+                edges = (beacons.get(0) or {}).get("blocked_on") or []
+                named = any(e[0] == 1 for e in edges)
+                if not named:
+                    time.sleep(0.2)
+            assert named, f"rank 0 never beaconed a wait edge on rank 1: {beacons}"
+            # (a) monitor --fleet shows the healthy rank blocked on the
+            # stalled rank, with the straggler's last-beaconed phase.
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli_main(["monitor", "--fleet", store_addr])
+            assert rc == 0
+            text = out.getvalue()
+            assert "rank 0 -> 1" in text, text
+            assert "last phase:" in text, text
+            # (c) fleet-health exits nonzero with a straggler event naming
+            # the same rank.
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli_main(["fleet-health", store_addr, "--json"])
+            assert rc == 1, out.getvalue()
+            payload = json.loads(out.getvalue())
+            stragglers = [
+                a for a in payload["anomalies"] if a["kind"] == "straggler"
+            ]
+            assert stragglers and stragglers[0]["rank"] == 1, payload
+            assert "blocked on rank 1" in stragglers[0]["detail"]
+            pend.wait()
+        # Both ranks converge and the snapshot is whole.
+        assert Snapshot(path).verify() == {}
+    if rank == 0:
+        # (b) the survivor's stall watchdog warning NAMES the peer and its
+        # last-beaconed phase.
+        warnings = [m for m in records if "snapshot drain stalled" in m]
+        assert warnings, "stall watchdog never fired on the surviving rank"
+        attributed = [m for m in warnings if '"blocked_on"' in m]
+        assert attributed, warnings
+        payload = json.loads(attributed[-1].split("stalled: ", 1)[1])
+        peers = {e["peer"] for e in payload["blocked_on"]}
+        assert 1 in peers, payload
+        assert any(
+            e["peer"] == 1 and e.get("peer_phase")
+            for e in payload["blocked_on"]
+        ), payload
+    fleet_mod.reset()
+
+
+@pytest.mark.multiprocess
+def test_mp_straggler_named_by_watchdog_and_fleet_health(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    run_with_processes(
+        _worker_straggler_named, nproc=2, args=(str(tmp_path),),
+        timeout_s=180.0,
+    )
+
+
+def _worker_beacon_chaos(rank: int, world_size: int, shared: str) -> None:
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.telemetry import fleet as fleet_mod
+    from torchsnapshot_tpu.telemetry import health as health_mod
+
+    store_addr = knobs.get_store_addr()
+    state = StateDict(v=np.full((1 << 14,), rank, dtype=np.float32))
+    path = os.path.join(shared, "ckpt")
+    with knobs.override_debug_ledger(True), knobs.override_debug_collectives(
+        True
+    ), knobs.override_fleet_telemetry("1"), knobs.override_fleet_beacon_s(
+        0.1
+    ):
+        fleet_mod.reset()
+        bus = fleet_mod.get_bus()
+        assert bus is not None
+        if rank == 1:
+            # Publish one healthy mid-op word, then kill the publisher:
+            # every later publish (including the op-end idle word) fails.
+            bus.note_op("take")
+            assert bus.publishes >= 1
+            with knobs.override_faults("op=beacon,kind=fail"):
+                Snapshot.take(path, {"m": state})
+                assert bus.publish_failures > 0
+        else:
+            Snapshot.take(path, {"m": state})
+        # The op committed regardless of the dead publisher: fail-open.
+        assert Snapshot(path).verify() == {}
+        if rank == 0:
+            # Rank 1's beacon is frozen at its mid-op last word; once it
+            # ages past the fence the dead-beacon detector fires.
+            interval = bus.interval_s
+            deadline = time.monotonic() + 30.0
+            dead = []
+            while time.monotonic() < deadline and not dead:
+                store = fleet_mod.connect(store_addr)
+                beacons = fleet_mod.read_beacons(store)
+                events = health_mod.detect_fleet_anomalies(beacons, interval)
+                dead = [
+                    e for e in events
+                    if e["kind"] == "dead_beacon" and e.get("rank") == 1
+                ]
+                if not dead:
+                    time.sleep(0.5)
+            assert dead, "dead-beacon detector never fired for the killed publisher"
+            assert "mid-op" in dead[0]["detail"]
+    fleet_mod.reset()
+
+
+@pytest.mark.multiprocess
+def test_mp_beacon_publisher_death_is_detected_not_fatal(tmp_path) -> None:
+    from torchsnapshot_tpu.test_utils import run_with_processes
+
+    run_with_processes(
+        _worker_beacon_chaos, nproc=2, args=(str(tmp_path),), timeout_s=120.0,
+    )

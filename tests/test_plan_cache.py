@@ -225,8 +225,13 @@ def _worker_async_take_cache_hit(rank, world_size, shared):
     app["s"]["step"] = 5
     pending = Snapshot.async_take(os.path.join(shared, "a1"), app)
     stall_counts = dict(counts)
+    # Coordination plane only: the fleet bus's rate-limited beacon set
+    # (auto-on at world>1) counts as telemetry.*, not a coordination
+    # round-trip.
     stall_ops = sum(
-        store_mod.get_op_counts(current_thread_only=True).values()
+        store_mod.get_op_counts(
+            current_thread_only=True, include_telemetry=False
+        ).values()
     )
     snap = pending.wait()
     assert stall_counts["all_gather"] == 0, stall_counts

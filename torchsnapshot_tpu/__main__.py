@@ -457,11 +457,45 @@ def _cmd_timeline(args: argparse.Namespace) -> int:
     return 1 if anomalies else 0
 
 
+def _cmd_fleet_monitor(args: argparse.Namespace) -> int:
+    """``monitor --fleet``: render live beacons from the coordinator store
+    instead of a flight-recorder dump. Shares timeline's exit contract —
+    always 0 unless the store itself is unreachable (global handler, 2)."""
+    import json
+    import time as _time
+
+    from .telemetry import aggregate, export, fleet
+
+    store = fleet.connect(args.fleet)
+    rounds = max(1, int(args.watch or 1))
+    history: list = []
+    view = None
+    for i in range(rounds):
+        beacons = fleet.read_beacons(store)
+        history.extend(beacons.values())
+        view = aggregate.fleet_view(beacons)
+        if args.json:
+            print(json.dumps(view, indent=2, sort_keys=True))
+        else:
+            if rounds > 1:
+                print(f"--- round {i + 1}/{rounds} ---")
+            for line in aggregate.format_fleet(view):
+                print(line)
+        if i + 1 < rounds:
+            _time.sleep(max(0.05, view.get("interval_s") or 0.5))
+    if args.trace:
+        export.write_trace_obj(export.fleet_beacon_trace(history), args.trace)
+        print(f"beacon trace ({len(history)} beacon(s)) -> {args.trace}")
+    return 0
+
+
 def _cmd_monitor(args: argparse.Namespace) -> int:
     import json
 
     from .utils import knobs
 
+    if args.fleet:
+        return _cmd_fleet_monitor(args)
     path = args.dump or knobs.get_recorder_dump_path()
     if not path:
         raise RuntimeError(
@@ -470,17 +504,27 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
         )
     with open(path, encoding="utf-8") as f:
         dump = json.load(f)
-    if args.json:
-        print(json.dumps(dump, indent=2, sort_keys=True))
-        return 0
-    samples = dump.get("samples") or []
     import time as _time
 
     age_s = _time.time() - dump.get("written_unix", 0.0)
+    # A live recorder rewrites the dump every RECORDER_INTERVAL_S; a dump
+    # much older than that is a dead process or a stale file, not an
+    # in-flight operation.
+    stale_after = max(3.0, 4.0 * knobs.get_recorder_interval_s())
+    stale = age_s > stale_after
+    if args.json:
+        print(json.dumps(dump, indent=2, sort_keys=True))
+        return 1 if (stale and args.expect_live) else 0
+    samples = dump.get("samples") or []
+    freshness = (
+        f"written {age_s:.1f}s ago"
+        if not stale
+        else f"STALE — written {age_s:.1f}s ago (> {stale_after:.1f}s)"
+    )
     print(
         f"flight recorder @ {path}: pid {dump.get('pid')}, "
         f"{len(samples)} sample(s) (capacity {dump.get('capacity')}, "
-        f"{dump.get('dropped', 0)} overwritten), written {age_s:.1f}s ago"
+        f"{dump.get('dropped', 0)} overwritten), {freshness}"
     )
     engine_samples = [s for s in samples if s.get("kind") == "engine.sample"]
     events = [s for s in samples if s.get("kind") != "engine.sample"]
@@ -508,7 +552,44 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
                 k: v for k, v in s.items() if k not in ("ts", "kind")
             }
             print(f"  {s.get('kind')}: {detail}")
-    return 0
+    return 1 if (stale and args.expect_live) else 0
+
+
+def _cmd_fleet_health(args: argparse.Namespace) -> int:
+    import json
+
+    from .telemetry import aggregate, fleet, health
+    from .utils import knobs
+
+    store = fleet.connect(args.store)
+    beacons = fleet.read_beacons(store)
+    view = aggregate.fleet_view(beacons)
+    interval_s = view.get("interval_s") or knobs.get_fleet_beacon_s()
+    anomalies = health.detect_fleet_anomalies(
+        beacons, interval_s, world_size=args.world_size
+    )
+    if args.json:
+        print(
+            json.dumps(
+                {"view": view, "anomalies": anomalies},
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        return 1 if anomalies else 0
+    for line in aggregate.format_fleet(view):
+        print(line)
+    if not beacons:
+        print("no beacons published (is TORCHSNAPSHOT_TPU_FLEET_TELEMETRY on?)")
+    if anomalies:
+        print(f"anomalies ({len(anomalies)}):")
+        for a in anomalies:
+            rank = a.get("rank")
+            where = f" rank={rank}" if rank is not None else ""
+            print(f"  {a.get('kind')}{where}: {a.get('detail')}")
+    else:
+        print("fleet healthy: no anomalies")
+    return 1 if anomalies else 0
 
 
 def main(argv=None) -> int:
@@ -725,7 +806,63 @@ def main(argv=None) -> int:
     p_monitor.add_argument(
         "--json", action="store_true", help="print the raw dump"
     )
+    p_monitor.add_argument(
+        "--expect-live",
+        action="store_true",
+        help=(
+            "exit 1 when the dump is stale (older than "
+            "4x TORCHSNAPSHOT_TPU_RECORDER_INTERVAL_S) — for scripted "
+            "liveness checks"
+        ),
+    )
+    p_monitor.add_argument(
+        "--fleet",
+        default=None,
+        metavar="HOST:PORT",
+        help=(
+            "read live fleet beacons from the coordinator store at this "
+            "address instead of a recorder dump (docs/observability.md)"
+        ),
+    )
+    p_monitor.add_argument(
+        "--watch",
+        type=int,
+        default=None,
+        metavar="N",
+        help="with --fleet: poll N rounds (one beacon interval apart)",
+    )
+    p_monitor.add_argument(
+        "--trace",
+        default=None,
+        metavar="OUT.json",
+        help=(
+            "with --fleet: write a Perfetto trace of the accumulated "
+            "beacon timeline (pid = rank)"
+        ),
+    )
     p_monitor.set_defaults(fn=_cmd_monitor)
+
+    p_fleet = sub.add_parser(
+        "fleet-health",
+        help=(
+            "fleet-level health verdict over live beacons: dead beacons, "
+            "stragglers, wait cycles, QoS starvation — exit 1 on anomalies "
+            "(same contract as timeline)"
+        ),
+    )
+    p_fleet.add_argument(
+        "store", help="coordinator store address (HOST:PORT)"
+    )
+    p_fleet.add_argument(
+        "--world-size",
+        type=int,
+        default=None,
+        help="expected rank count (default: max world_size seen in beacons)",
+    )
+    p_fleet.add_argument(
+        "--json", action="store_true", help="machine-readable view + anomalies"
+    )
+    p_fleet.set_defaults(fn=_cmd_fleet_health)
 
     args = parser.parse_args(argv)
     try:

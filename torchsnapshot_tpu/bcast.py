@@ -364,6 +364,19 @@ class _BcastSession:
         return data
 
 
+async def _obtain_wait(session, idx, attempt, deadline, poll_s):
+    """Poll one fenced broadcast key until a payload appears or ``deadline``
+    passes. Returns the raw payload (marker byte included) or ``None`` on
+    deadline — classification and logging stay with the caller."""
+    while True:
+        payload = await session.try_get(idx, attempt)
+        if payload is not None:
+            return payload
+        if time.monotonic() >= deadline:
+            return None
+        await asyncio.sleep(poll_s)
+
+
 def run_broadcast(
     items: List[BroadcastItem],
     storage: StoragePlugin,
@@ -469,43 +482,49 @@ def run_broadcast(
                 tracker.note_staged(len(data))
                 return data, "fetched"
             deadline = time.monotonic() + deadline_s
-            while True:
-                payload = await session.try_get(idx, attempt)
-                if payload is not None:
-                    if payload[:1] == _OK:
-                        data = payload[1:]
-                        tracker.note_staged(len(data))
-                        return data, "received"
-                    # Error marker: the reader reached origin and failed
-                    # permanently. Waiting longer proves nothing — fall
-                    # back to a direct read (the fault may be scoped to
-                    # the reader's rank).
+            # Fleet wait edge: while polling for the elected reader's post
+            # this rank is blocked ON that reader — beacon the edge so the
+            # fleet view (and a peer's watchdog) names the rank, not just
+            # "restore is slow". Cleared whatever way the wait ends.
+            wait_site = f"bcast.obtain:{idx}"
+            telemetry.fleet.note_blocked(wait_site, [reader])
+            try:
+                payload = await _obtain_wait(
+                    session, idx, attempt, deadline, poll_s
+                )
+            finally:
+                telemetry.fleet.clear_blocked(wait_site)
+            if payload is not None and payload[:1] == _OK:
+                data = payload[1:]
+                tracker.note_staged(len(data))
+                return data, "received"
+            if payload is None:
+                if attempt + 1 < max_attempts:
+                    telemetry.counter_add("bcast.reelections")
+                    LAST_RESTORE_BCAST["reelections"] += 1
                     logger.warning(
-                        "broadcast reader rank %d reported a failed read "
-                        "of %s (%s); falling back to a direct origin read",
+                        "broadcast reader rank %d missed the %.1fs "
+                        "deadline for %s; re-electing rank %d "
+                        "(attempt %d)",
                         reader,
+                        deadline_s,
                         key[0],
-                        payload[1:].decode(errors="replace"),
+                        order[attempt + 1],
+                        attempt + 1,
                     )
-                    break
-                if time.monotonic() >= deadline:
-                    if attempt + 1 < max_attempts:
-                        telemetry.counter_add("bcast.reelections")
-                        LAST_RESTORE_BCAST["reelections"] += 1
-                        logger.warning(
-                            "broadcast reader rank %d missed the %.1fs "
-                            "deadline for %s; re-electing rank %d "
-                            "(attempt %d)",
-                            reader,
-                            deadline_s,
-                            key[0],
-                            order[attempt + 1],
-                            attempt + 1,
-                        )
-                    break
-                await asyncio.sleep(poll_s)
-            if payload is not None and payload[:1] == _ERR:
-                break  # error marker: straight to the direct fallback
+                continue
+            # Error marker: the reader reached origin and failed
+            # permanently. Waiting longer proves nothing — fall back to
+            # a direct read (the fault may be scoped to the reader's
+            # rank).
+            logger.warning(
+                "broadcast reader rank %d reported a failed read "
+                "of %s (%s); falling back to a direct origin read",
+                reader,
+                key[0],
+                payload[1:].decode(errors="replace"),
+            )
+            break
         # Re-election budget exhausted (or the reader hit a permanent
         # origin error): direct origin read. Broadcast mode can never be
         # less available than direct mode — a peer that can reach the

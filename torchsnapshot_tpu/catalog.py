@@ -93,6 +93,7 @@ PIN_DIR = f"{CATALOG_DIR}/pins"
 # same lifecycle (retention GC keeps a step record exactly as long as its
 # snapshot's catalog record).
 STEP_TELEMETRY_DIR = f"{CATALOG_DIR}/telemetry"
+ROLLOUT_TELEMETRY_DIR = f"{CATALOG_DIR}/rollouts"
 
 # Bump when the record layout changes incompatibly. Loaders skip records
 # with a NEWER schema (a downgraded reader must not misinterpret them) and
@@ -186,6 +187,18 @@ def step_record_path(job: str, name: str, step: int) -> str:
     return (
         f"{STEP_TELEMETRY_DIR}/{_slug(job)}/"
         f"{max(0, int(step)):020d}-{_name_key(name)}.json"
+    )
+
+
+def rollout_record_path(job: str, name: str, step: Optional[int], rank: int) -> str:
+    """Catalog object path of one RANK's rollout (restore-side) record.
+    Same layout as :func:`step_record_path` under a ``rollouts/`` sibling,
+    with the rank in the filename: restores append per-process (there is no
+    commit barrier to elect a merger behind), so per-rank files avoid
+    last-writer-wins collisions by construction."""
+    return (
+        f"{ROLLOUT_TELEMETRY_DIR}/{_slug(job)}/"
+        f"{max(0, int(step or 0)):020d}-{_name_key(name)}_r{int(rank)}.json"
     )
 
 
@@ -425,6 +438,93 @@ class Catalog:
         return sorted(
             by_name.values(),
             key=lambda r: (r.get("step", 0), r.get("created_unix", 0.0)),
+        )
+
+    def append_rollout_record(self, record: Dict[str, Any]) -> bool:
+        """Atomically write one rank's rollout (restore-side) record —
+        built by ``telemetry.steprecord.build_rollout_record``. Fail-open
+        like :meth:`append_step_telemetry`: a missed record loses one point
+        of the restore trend line, never the restore itself."""
+        path = rollout_record_path(
+            str(record.get("job", "")),
+            str(record.get("name", "")),
+            record.get("step"),
+            int(record.get("rank", 0) or 0),
+        )
+        try:
+            from .telemetry import steprecord
+
+            with telemetry.span(
+                "catalog.rollout_append", cat="catalog", path=path
+            ):
+                self._storage.sync_write(
+                    WriteIO(
+                        path=path, buf=steprecord.dumps_rollout_record(record)
+                    ),
+                    self._loop,
+                )
+            telemetry.counter_add("catalog.rollout_appends")
+            return True
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            telemetry.counter_add("catalog.rollout_append_failures")
+            logger.warning(
+                "rollout record append for %s under %s failed (restore "
+                "unaffected)",
+                record.get("name"),
+                self.bucket_url,
+                exc_info=True,
+            )
+            return False
+
+    def load_rollout_telemetry(
+        self, job: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """All readable rollout records, (step, rank, created) order.
+        Per-rank records are NOT merged — restore skew across ranks is the
+        signal. Unreadable or newer-schema records are skipped with one
+        warning each."""
+        from .telemetry import steprecord
+
+        prefix = (
+            ROLLOUT_TELEMETRY_DIR
+            if job is None
+            else f"{ROLLOUT_TELEMETRY_DIR}/{_slug(job)}"
+        )
+        out: List[Dict[str, Any]] = []
+        with telemetry.span(
+            "catalog.rollout_scan", cat="catalog", path=prefix
+        ):
+            try:
+                paths = _run(self._storage.list_prefix(prefix), self._loop)
+            except FileNotFoundError:
+                return []
+            for p in sorted(paths):
+                if not p.endswith(".json"):
+                    continue
+                try:
+                    read_io = ReadIO(path=p)
+                    self._storage.sync_read(read_io, self._loop)
+                    rec = steprecord.parse_rollout_record(
+                        read_io.buf.getvalue()
+                    )
+                except Exception:  # noqa: BLE001 - degrade, never fail
+                    logger.warning(
+                        "unreadable rollout record %s under %s (skipped)",
+                        p,
+                        self.bucket_url,
+                        exc_info=True,
+                    )
+                    continue
+                if job is not None and rec.get("job") != job:
+                    continue
+                out.append(rec)
+        return sorted(
+            out,
+            key=lambda r: (
+                r.get("step") or 0,
+                r.get("rank", 0),
+                r.get("created_unix", 0.0),
+            ),
         )
 
     # --------------------------------------------------------------- load

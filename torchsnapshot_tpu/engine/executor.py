@@ -344,6 +344,7 @@ class GraphExecutor:
                     # the higher class's demand to clear. Keep sampling —
                     # pause waves are exactly what the recorder is for.
                     telemetry.recorder.sample_engine(self)
+                    telemetry.fleet.sample_engine(self)
                     await asyncio.sleep(knobs.get_qos_poll_s())
                     continue
                 done, _ = await asyncio.wait(
@@ -360,6 +361,7 @@ class GraphExecutor:
                     self.occupancy(), self._bytes_done(), self.budget
                 )
                 telemetry.recorder.sample_engine(self)
+                telemetry.fleet.sample_engine(self)
         finally:
             self._arbiter.unregister(self.priority)
             self._note_resumed()
@@ -644,6 +646,7 @@ class GraphExecutor:
             return None
         def on_fire() -> None:
             telemetry.counter_add("scheduler.stall_warnings", 1)
+            telemetry.fleet.note_anomaly("stall_warning")
             telemetry.recorder.record_event(
                 "engine.stall_warning",
                 {

@@ -183,15 +183,27 @@ async def pause_point(
     max_pause = knobs.get_qos_max_pause_s()
     poll = knobs.get_qos_poll_s()
     telemetry.counter_add("engine.preemptions")
+    demand = arb.demand_snapshot()
     telemetry.recorder.record_event(
         "engine.pause",
-        {"engine": "pause_point", "priority": p.name,
-         "demand": arb.demand_snapshot()},
+        {"engine": "pause_point", "priority": p.name, "demand": demand},
     )
-    while arb.preempted(p):
-        if max_pause > 0 and time.monotonic() - t0 >= max_pause:
-            break
-        await asyncio.sleep(poll)
+    # Fleet wait edge: name the class(es) holding demand above us, so a
+    # peer reading this rank's beacon sees "paused for class:FOREGROUND"
+    # rather than an unattributed stall. Cleared when the pause ends.
+    holders = [
+        f"class:{q.name}"
+        for q in Priority
+        if q > p and demand.get(q.name, 0) > 0
+    ]
+    telemetry.fleet.note_blocked("qos.pause", holders)
+    try:
+        while arb.preempted(p):
+            if max_pause > 0 and time.monotonic() - t0 >= max_pause:
+                break
+            await asyncio.sleep(poll)
+    finally:
+        telemetry.fleet.clear_blocked("qos.pause")
     waited = time.monotonic() - t0
     telemetry.counter_add("engine.preempted_wait_s", waited)
     telemetry.histogram_observe("engine.pause_s", waited)

@@ -137,6 +137,7 @@ _OPS = (
     "catalog_append",
     "steprecord_append",
     "cache_bitmap",
+    "beacon",
     "any",
 )
 
@@ -189,10 +190,14 @@ _CRASH_SURFACE = (
     ("cache.py:CachedStoragePlugin._write_entry_range", "fail-open"),
     ("cache.py:CachedStoragePlugin.quarantine_path", "fail-open"),
     ("catalog.py:Catalog.append", "catalog_append"),
+    # Restore-side rollout records are fail-open telemetry sidecars: a
+    # crash mid-append loses at most one record and the snapshot itself
+    # is untouched (appends happen strictly after the restore completes).
+    ("catalog.py:Catalog.append_rollout_record", "fail-open"),
     ("catalog.py:Catalog.append_step_telemetry", "steprecord_append"),
     ("catalog.py:Catalog.pin", "write"),
     ("catalog.py:Catalog.unpin", "delete"),
-    ("export.py:write_chrome_trace", "fail-open"),
+    ("export.py:write_trace_obj", "fail-open"),
     ("fs.py:FSStoragePlugin._link_in_inner", "link"),
     ("fs.py:FSStoragePlugin._write_inner", "write"),
     ("fs.py:_FSWriteStream._abort_work", "abort"),

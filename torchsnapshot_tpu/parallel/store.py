@@ -21,6 +21,7 @@ raises instead of deadlocking, and the leader never commits.
 from __future__ import annotations
 
 import abc
+import contextlib
 import pickle
 import socket
 import socketserver
@@ -48,20 +49,50 @@ _OP_LOCK = threading.Lock()
 # background commit thread's LinearBarrier polling.
 _OP_COUNTS: Dict[tuple, int] = {}
 
+_TELEMETRY_OP = threading.local()
+
+
+@contextlib.contextmanager
+def telemetry_op_scope():
+    """Mark store ops issued inside as telemetry-plane traffic.
+
+    Fleet beacon publishes/reads and wait-graph probes are real store
+    round-trips, but they are rate-limited diagnostics, not per-take
+    coordination: counting them as ``telemetry.<op>`` keeps them visible
+    in the op counters while letting coordination-cost measurements (the
+    published 3-round-trips-per-stall claim and its pinning test) exclude
+    them with ``include_telemetry=False``."""
+    prev = getattr(_TELEMETRY_OP, "on", False)
+    _TELEMETRY_OP.on = True
+    try:
+        yield
+    finally:
+        _TELEMETRY_OP.on = prev
+
 
 def _count_op(op: str) -> None:
+    if getattr(_TELEMETRY_OP, "on", False):
+        op = f"telemetry.{op}"
     key = (threading.get_ident(), op)
     with _OP_LOCK:
         _OP_COUNTS[key] = _OP_COUNTS.get(key, 0) + 1
 
 
-def get_op_counts(current_thread_only: bool = False) -> Dict[str, int]:
-    """{op: count} since the last reset (set/get/try_get/add/delete)."""
+def get_op_counts(
+    current_thread_only: bool = False, include_telemetry: bool = True
+) -> Dict[str, int]:
+    """{op: count} since the last reset (set/get/try_get/add/delete).
+
+    Ops issued under :func:`telemetry_op_scope` count as
+    ``telemetry.<op>``; pass ``include_telemetry=False`` to measure the
+    coordination plane alone."""
     me = threading.get_ident()
     out: Dict[str, int] = {}
     with _OP_LOCK:
         for (tid, op), n in _OP_COUNTS.items():
             if current_thread_only and tid != me:
+                continue
+            if not include_telemetry and op.startswith("telemetry."):
                 continue
             out[op] = out.get(op, 0) + n
     return out
@@ -512,6 +543,19 @@ class BarrierError(RuntimeError):
         self.phase = phase
 
 
+class BarrierTimeout(TimeoutError):
+    """A barrier phase timed out. Carries the ranks whose arrival markers
+    were still missing at the deadline, so the abort path can NAME the
+    straggler (and, through the fleet bus, its last-beaconed phase) instead
+    of reporting an unattributed timeout."""
+
+    def __init__(self, message: str, phase: str,
+                 missing_ranks: Optional[List[int]] = None) -> None:
+        super().__init__(message)
+        self.phase = phase
+        self.missing_ranks = list(missing_ranks or [])
+
+
 class LinearBarrier:
     """Two-phase store barrier with leader critical section + error fan-out.
 
@@ -563,50 +607,105 @@ class LinearBarrier:
             f"rank {rank} failed{detail}: {msg}", rank=rank, phase=phase
         )
 
+    def _missing_ranks(self, phase: str) -> List[int]:
+        """Ranks whose per-rank arrival markers for ``phase`` are absent —
+        the peers everyone still waits on. Best-effort diagnostics: one
+        bulk round trip (counted as telemetry, not coordination), [] on
+        any store failure."""
+        try:
+            with telemetry_op_scope():
+                vals = self._store.try_get_many(
+                    [f"{phase}/r{r}" for r in range(self._world_size)]
+                )
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            return []
+        return [
+            r
+            for r, v in enumerate(vals)
+            if v is None and r != self._rank
+        ]
+
     def _phase(self, phase: str, timeout_s: float) -> None:
         from ..collective_tracer import active_tracer
+        from ..telemetry import fleet
 
         tracer = active_tracer()
         if tracer is not None:
             tracer.record(f"barrier.{phase}", self._barrier_id)
+        # Per-rank arrival marker beside the shared counter: the counter
+        # says HOW MANY arrived, the markers say WHO — what timeout
+        # attribution and the fleet wait graph are built from.
+        self._store.set(f"{phase}/r{self._rank}", b"1")
         count = self._store.add(phase, 1)
         if count == self._world_size:
             self._store.set(f"{phase}/done", b"1")
         deadline = time.monotonic() + timeout_s
-        while True:
-            err = self._store.try_get("error")
-            if err is not None:
-                raise self._unpickle_error(err)
-            try:
-                self._store.get(f"{phase}/done", timeout_s=1.0)
-                # report_error() force-sets the done keys to unblock waiters,
-                # so re-check for a peer failure before declaring success.
+        wait_site = f"barrier.{phase}:{self._barrier_id}"
+        # The first poll round is short so a genuine wait feeds its fleet
+        # edge within 0.25 s — the commit-barrier stall watchdog fires
+        # EXACTLY ONCE per stall, usually well inside a ~1 s round, and
+        # its one warning must already carry the peer attribution. A
+        # healthy barrier (arrival skew under the short round) completes
+        # inside the first get and pays zero extra store ops, preserving
+        # the constant steady-state coordination cost.
+        poll_s = 0.25
+        try:
+            while True:
                 err = self._store.try_get("error")
                 if err is not None:
                     raise self._unpickle_error(err)
-                if tracer is not None and (
-                    threading.current_thread() is threading.main_thread()
-                ):
-                    # Every rank just passed this phase; cross-check the
-                    # lockstep fingerprint under the barrier's own (rank-
-                    # independent) namespace. Background-thread barriers
-                    # (the async commit) skip the check: their interleaving
-                    # against main-thread planning collectives is timing,
-                    # not SPMD divergence.
-                    tracer.crosscheck(
-                        self._store,
-                        self._rank,
-                        self._world_size,
-                        phase,
-                        timeout_s,
-                    )
-                return
-            except TimeoutError:
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"LinearBarrier {phase} timed out "
-                        f"({count}/{self._world_size} arrived)"
-                    )
+                try:
+                    self._store.get(f"{phase}/done", timeout_s=poll_s)
+                    # report_error() force-sets the done keys to unblock
+                    # waiters, so re-check for a peer failure before
+                    # declaring success.
+                    err = self._store.try_get("error")
+                    if err is not None:
+                        raise self._unpickle_error(err)
+                    if tracer is not None and (
+                        threading.current_thread() is threading.main_thread()
+                    ):
+                        # Every rank just passed this phase; cross-check the
+                        # lockstep fingerprint under the barrier's own (rank-
+                        # independent) namespace. Background-thread barriers
+                        # (the async commit) skip the check: their
+                        # interleaving against main-thread planning
+                        # collectives is timing, not SPMD divergence.
+                        tracer.crosscheck(
+                            self._store,
+                            self._rank,
+                            self._world_size,
+                            phase,
+                            timeout_s,
+                        )
+                    return
+                except TimeoutError:
+                    # One poll round (0.25 s first, ~1 s after) elapsed
+                    # without the phase completing: feed the fleet wait
+                    # graph with who is still missing, and keep this
+                    # rank's beacon fresh while it waits. Cheap (one bulk
+                    # probe per round) and only when the bus is live.
+                    poll_s = 1.0
+                    if fleet.enabled():
+                        fleet.note_blocked(
+                            wait_site, self._missing_ranks(phase)
+                        )
+                        fleet.heartbeat()
+                    if time.monotonic() > deadline:
+                        missing = self._missing_ranks(phase)
+                        detail = ""
+                        if missing:
+                            detail = "; waiting on rank(s) " + ", ".join(
+                                str(r) for r in missing
+                            )
+                        raise BarrierTimeout(
+                            f"LinearBarrier {phase} timed out "
+                            f"({count}/{self._world_size} arrived{detail})",
+                            phase=phase,
+                            missing_ranks=missing,
+                        )
+        finally:
+            fleet.clear_blocked(wait_site)
 
     def report_error(self, e: Exception, phase: Optional[str] = None) -> None:
         from ..collective_tracer import active_tracer

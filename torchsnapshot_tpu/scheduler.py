@@ -295,6 +295,10 @@ class _WritePipeline:
         # as staging-cost estimates and converge on actual bytes as staging
         # completes, so bytes_written ends equal to the payload total.
         self.progress = telemetry.ProgressTracker()
+        # Fleet beacons carry this pipeline's rates/ETA; latest tracker wins
+        # (one drain at a time per class, and a stale tracker just reads as
+        # a finished drain). One is-None check when the bus is off.
+        telemetry.fleet.set_progress(self.progress)
         self.progress.set_totals(
             requests=len(write_reqs),
             bytes_=sum(

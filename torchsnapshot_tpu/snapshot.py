@@ -136,6 +136,32 @@ def _qos_scope(qos: Any):
             yield
 
 
+@contextlib.contextmanager
+def _barrier_stall_guard(rank: int):
+    """Arm a thread-mode stall watchdog around a synchronous barrier hold.
+
+    The engine's own watchdog dies with its event loop, but the place a
+    straggler actually parks peers is the commit/post-load LinearBarrier —
+    a plain blocking poll loop with no loop to ride. A fresh tracker never
+    moves bytes, so the watchdog fires exactly once after the stall-warn
+    threshold, and its warning carries ``blocked_on`` (the barrier's fleet
+    wait edges) naming the missing peer(s). No-op when the stall-warn knob
+    is off."""
+    warn_s = knobs.get_stall_warn_s()
+    if warn_s <= 0:
+        yield
+        return
+    watchdog = telemetry.StallWatchdog(
+        telemetry.ProgressTracker(), warn_s, rank=rank
+    )
+    thread, stop = telemetry.watchdog_thread(watchdog)
+    try:
+        yield
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)
+
+
 def _restore_attribution(
     bcast_rec: Dict[str, Any],
     swarm_rec: Dict[str, Any],
@@ -228,7 +254,15 @@ def _finish_telemetry(
     if trace_path:
         path = trace_path if rank == 0 else f"{trace_path}.rank{rank}"
         try:
-            telemetry.write_chrome_trace(tm, path)
+            # Flight-recorder engine samples ride along as Perfetto counter
+            # tracks (write rate, budget HWM) beside the span tracks — only
+            # when the recorder is live; "C" events are ignored by the
+            # trace round-trip readers.
+            samples = None
+            rec = telemetry.recorder.get_recorder()
+            if rec is not None:
+                samples = rec.snapshot()
+            telemetry.write_chrome_trace(tm, path, recorder_samples=samples)
         except Exception:  # noqa: BLE001 - diagnostics must not fail the op
             logger.warning(
                 "failed to write telemetry trace to %s", path, exc_info=True
@@ -367,9 +401,23 @@ def _abort_exception(
     if not isinstance(e, Exception):
         return e
     if isinstance(e, TimeoutError):
-        # The barrier (or a store collective) timed out: some peer died
-        # without reporting. Unattributable, but still a structured abort.
-        return CheckpointAbortedError(path, None, phase, repr(e))
+        # The barrier (or a store collective) timed out: a peer died or
+        # wedged without reporting. The barrier's per-rank arrival markers
+        # name WHO is missing, and the fleet bus (when live) adds WHAT it
+        # was last doing — "rank 1 (last phase: restore.read)" instead of
+        # an unattributed timeout.
+        missing = list(getattr(e, "missing_ranks", None) or [])
+        culprit: Optional[int] = missing[0] if missing else None
+        detail = repr(e)
+        if culprit is not None:
+            last_phase = None
+            try:
+                last_phase = telemetry.fleet.peer_phase(culprit)
+            except Exception:  # noqa: BLE001 - attribution is best-effort
+                pass
+            if last_phase:
+                detail = f"{detail} (last beaconed phase: {last_phase})"
+        return CheckpointAbortedError(path, culprit, phase, detail)
     return CheckpointAbortedError(path, rank, phase, repr(e))
 
 
@@ -512,6 +560,7 @@ class Snapshot:
         rank = coord.get_rank()
         base = cls._maybe_auto_base(base, job, max_chain_len)
         tm, tm_prev = _begin_telemetry(_telemetry)
+        telemetry.fleet.note_op("take")
         try:
             plan = cls._plan_take(path, app_state, coord, replicated or [], base)
             event_loop = asyncio.new_event_loop()
@@ -561,7 +610,8 @@ class Snapshot:
                 )
                 # Commit metadata only after ALL ranks finished writing data.
                 phase = "commit"
-                with telemetry.span("take.commit", cat="take"):
+                with telemetry.span("take.commit", cat="take"), \
+                        _barrier_stall_guard(rank):
                     if barrier is not None:
                         barrier.arrive()
                     if rank == 0:
@@ -594,6 +644,10 @@ class Snapshot:
                         # let the coordinator collect collective keys
                         # posted before it.
                         coord.note_external_barrier()
+                # Main-thread op end on the fleet bus: GC superseded beacon
+                # generations (bounded store occupancy) — fail-open, no-op
+                # when the bus is off.
+                telemetry.fleet.gc_beacons()
                 if job is not None:
                     _note_chain_commit(plan, job)
             except BaseException as e:
@@ -608,6 +662,9 @@ class Snapshot:
                 storage.sync_close(event_loop)
                 event_loop.close()
         finally:
+            # The op's LAST beacon is an idle one (force-published): peers'
+            # dead-beacon detection keys off "last word was mid-op".
+            telemetry.fleet.note_op(None)
             _finish_telemetry(tm, tm_prev, coord.get_rank())
         snapshot = cls(path=plan.path, coordinator=coord)
         snapshot._metadata = metadata
@@ -682,6 +739,7 @@ class Snapshot:
     ) -> "PendingSnapshot":
         base = cls._maybe_auto_base(base, job, max_chain_len)
         tm, tm_prev = _begin_telemetry(_telemetry)
+        telemetry.fleet.note_op("async_take")
         try:
             plan = cls._plan_take(path, app_state, coord, replicated or [], base)
             event_loop = asyncio.new_event_loop()
@@ -704,6 +762,7 @@ class Snapshot:
                 event_loop.close()
                 raise
         except BaseException:
+            telemetry.fleet.note_op(None)
             _finish_telemetry(tm, tm_prev, coord.get_rank())
             raise
         return PendingSnapshot(
@@ -1253,6 +1312,67 @@ class Snapshot:
                 exc_info=True,
             )
 
+    def _append_rollout_record(
+        self,
+        *,
+        job: str,
+        step: Optional[int],
+        rank: int,
+        world_size: int,
+        event_loop: asyncio.AbstractEventLoop,
+    ) -> None:
+        """This rank's restore-side (rollout) record: wall time + byte
+        attribution from ``LAST_RESTORE_STATS``, appended under the
+        bucket's catalog. Per-rank (every rank appends its own file — no
+        commit barrier exists to elect a merger behind) and fail-open by
+        contract: a telemetry problem never fails the restore."""
+        if not knobs.is_catalog_enabled():
+            return
+        if not knobs.is_step_telemetry_enabled():
+            return
+        import re as _re
+
+        from . import catalog as catalog_mod
+
+        try:
+            split = catalog_mod.split_bucket(self.path)
+            if split is None:
+                logger.warning(
+                    "snapshot %s has no parent bucket; rollout record "
+                    "skipped", self.path,
+                )
+                return
+            bucket, name = split
+            if step is None:
+                m = _re.search(r"(\d+)$", name)
+                step = int(m.group(1)) if m else None
+            attr = LAST_RESTORE_STATS.get("attribution") or {}
+            swarm_rec = LAST_RESTORE_STATS.get("swarm") or {}
+            bcast_rec = LAST_RESTORE_STATS.get("bcast") or {}
+            if swarm_rec.get("chunks_peer") or swarm_rec.get("chunks_origin"):
+                mode = "swarm"
+            elif bcast_rec.get("entries") or bcast_rec.get("received"):
+                mode = "bcast"
+            else:
+                mode = "direct"
+            record = telemetry.steprecord.build_rollout_record(
+                job=job,
+                step=step,
+                name=name,
+                rank=rank,
+                world_size=world_size,
+                wall_s=LAST_RESTORE_STATS.get("wall_s", 0.0) or 0.0,
+                attribution=attr,
+                mode=mode,
+            )
+            with catalog_mod.Catalog(bucket, event_loop=event_loop) as cat:
+                cat.append_rollout_record(record)
+        except Exception:  # noqa: BLE001 - fail-open by contract
+            logger.warning(
+                "rollout record for %s could not be appended (restore "
+                "unaffected)", self.path, exc_info=True,
+            )
+
     @classmethod
     def _load_base_digests(
         cls, base: str, event_loop: asyncio.AbstractEventLoop
@@ -1370,6 +1490,8 @@ class Snapshot:
         _telemetry: Optional["telemetry.Telemetry"] = None,
         include: Optional[List[str]] = None,
         qos: Any = None,
+        job: Optional[str] = None,
+        step: Optional[int] = None,
     ) -> None:
         """``include``: optional list of logical-path globs (e.g.
         ``["model/encoder/*"]``) restricting the restore to the matching
@@ -1396,21 +1518,32 @@ class Snapshot:
         background drain, scrub, gc, cache populate, a background swarm
         fetch) pauses its next admission at chunk granularity until this
         restore completes; see ``benchmarks/qos/`` for the measured p99
-        effect."""
+        effect.
+
+        ``job``/``step``: opt into the catalog's ROLLOUT record stream —
+        each rank appends one compact restore-side record (wall time,
+        origin/peer/cache byte attribution) under the bucket's
+        ``.catalog/rollouts/``, the read half of the step-telemetry series
+        the ``timeline`` CLI trends. Fail-open like every telemetry
+        surface; ``step`` defaults to trailing digits of the snapshot
+        name."""
         with _qos_scope(qos):
-            self._restore_impl(app_state, _telemetry, include)
+            self._restore_impl(app_state, _telemetry, include, job, step)
 
     def _restore_impl(
         self,
         app_state: AppState,
         _telemetry: Optional["telemetry.Telemetry"] = None,
         include: Optional[List[str]] = None,
+        job: Optional[str] = None,
+        step: Optional[int] = None,
     ) -> None:
         self._validate_app_state(app_state)
         event_loop = asyncio.new_event_loop()
         coord = get_coordinator(self._coordinator)
         rank = coord.get_rank()
         tm, tm_prev = _begin_telemetry(_telemetry)
+        telemetry.fleet.note_op("restore")
         restore_t0 = time.monotonic()
         from . import bcast as bcast_mod
         from . import swarm as swarm_mod
@@ -1545,12 +1678,16 @@ class Snapshot:
             # timeout.
             phase = "restore.barrier"
             if barrier is not None:
-                barrier.arrive()
-                barrier.depart()
+                with _barrier_stall_guard(rank):
+                    barrier.arrive()
+                    barrier.depart()
                 # Full-world rendezvous: the coordinator may collect
                 # collective keys (incl. broadcast-restore payloads)
                 # posted before it.
                 coord.note_external_barrier()
+            # Main-thread op end on the fleet bus: GC superseded beacon
+            # generations (bounded store occupancy).
+            telemetry.fleet.gc_beacons()
             LAST_RESTORE_STATS.update(read_totals)
             LAST_RESTORE_STATS["wall_s"] = time.monotonic() - restore_t0
             LAST_RESTORE_STATS["bcast"] = dict(bcast_mod.LAST_RESTORE_BCAST)
@@ -1561,6 +1698,14 @@ class Snapshot:
                 read_totals,
                 storage,
             )
+            if job is not None:
+                self._append_rollout_record(
+                    job=job,
+                    step=step,
+                    rank=rank,
+                    world_size=coord.get_world_size(),
+                    event_loop=event_loop,
+                )
         except BaseException as e:
             aborted = _abort_exception(self.path, barrier, rank, phase, e)
             if aborted is e:
@@ -1574,6 +1719,7 @@ class Snapshot:
                 raise
             raise aborted from e
         finally:
+            telemetry.fleet.note_op(None)
             pools.shutdown()
             storage.sync_close(event_loop)
             event_loop.close()
@@ -3741,26 +3887,29 @@ class PendingSnapshot:
                 io_summary=pending_io_work.telemetry_io_summary(),
             )
             self._phase = "commit"
-            barrier.arrive()
-            if rank == 0:
-                Snapshot._write_snapshot_metadata(self._metadata, storage, event_loop)
-                if self._catalog_info is not None:
-                    # Same pre-barrier discipline as the sync path: the
-                    # record lands after metadata, before peers are
-                    # released. Fail-open; storage-only (no collectives
-                    # are legal on this thread, and none are used).
-                    job, step, base, chain_len = self._catalog_info
-                    Snapshot._append_catalog_record(
-                        self.path,
-                        storage,
-                        event_loop,
-                        world_size=self._metadata.world_size,
-                        job=job,
-                        step=step,
-                        base=base,
-                        chain_len=chain_len,
+            with _barrier_stall_guard(rank):
+                barrier.arrive()
+                if rank == 0:
+                    Snapshot._write_snapshot_metadata(
+                        self._metadata, storage, event_loop
                     )
-            barrier.depart()
+                    if self._catalog_info is not None:
+                        # Same pre-barrier discipline as the sync path: the
+                        # record lands after metadata, before peers are
+                        # released. Fail-open; storage-only (no collectives
+                        # are legal on this thread, and none are used).
+                        job, step, base, chain_len = self._catalog_info
+                        Snapshot._append_catalog_record(
+                            self.path,
+                            storage,
+                            event_loop,
+                            world_size=self._metadata.world_size,
+                            job=job,
+                            step=step,
+                            base=base,
+                            chain_len=chain_len,
+                        )
+                barrier.depart()
             if self._catalog_info is not None:
                 from . import catalog as catalog_mod
 
@@ -3800,6 +3949,10 @@ class PendingSnapshot:
                 event_loop.close()
             except Exception:
                 pass
+            # Op end on the fleet bus from the commit thread (the publish
+            # is plain store traffic — legal here; beacon GC stays on the
+            # main thread with the coordinator's deferred deletes).
+            telemetry.fleet.note_op(None)
             _finish_telemetry(self._tm, self._tm_prev, rank)
             self._done.set()
 

@@ -879,100 +879,115 @@ def run_swarm(
             have[k] = True
 
         loop = asyncio.get_running_loop()
-        while wanted:
-            served_now: List[int] = []
-            for k in list(wanted):
-                server = plan.orders[k][attempt[k]]
-                if server == rank:
-                    # Re-elected (or this rank's attempt-0 serve failed):
-                    # serve the chunk under THIS attempt's fenced key.
-                    try:
-                        data = await origin_fetch(plan, obj, k)
-                    except ReadVerificationError:
-                        raise
-                    except Exception as e:  # noqa: BLE001 - reported
-                        await session.post(
-                            obj, k, attempt[k], _ERR + repr(e).encode()
-                        )
-                        raise
-                    b, e = plan.extents[k]
-                    buf[b:e] = data
-                    have[k] = True
-                    payload = bytearray(data)
-                    await session.peer_serve_fault(plan, k, payload)
-                    await session.post(obj, k, attempt[k], _OK + bytes(payload))
-                    served_now.append(k)
-            for k in served_now:
-                wanted.remove(k)
-                await ack_once(k)
-            if not wanted:
-                break
-            keys = [session._key(obj, k, attempt[k]) for k in wanted]
-            payloads = await session.try_get_many(keys)
-            now = time.monotonic()
-            for k, payload in list(zip(list(wanted), payloads)):
-                if payload is None:
-                    if now < deadline[k]:
+        try:
+            while wanted:
+                served_now: List[int] = []
+                for k in list(wanted):
+                    server = plan.orders[k][attempt[k]]
+                    if server == rank:
+                        # Re-elected (or this rank's attempt-0 serve failed):
+                        # serve the chunk under THIS attempt's fenced key.
+                        try:
+                            data = await origin_fetch(plan, obj, k)
+                        except ReadVerificationError:
+                            raise
+                        except Exception as e:  # noqa: BLE001 - reported
+                            await session.post(
+                                obj, k, attempt[k], _ERR + repr(e).encode()
+                            )
+                            raise
+                        b, e = plan.extents[k]
+                        buf[b:e] = data
+                        have[k] = True
+                        payload = bytearray(data)
+                        await session.peer_serve_fault(plan, k, payload)
+                        await session.post(obj, k, attempt[k], _OK + bytes(payload))
+                        served_now.append(k)
+                for k in served_now:
+                    wanted.remove(k)
+                    await ack_once(k)
+                if not wanted:
+                    break
+                keys = [session._key(obj, k, attempt[k]) for k in wanted]
+                payloads = await session.try_get_many(keys)
+                now = time.monotonic()
+                for k, payload in list(zip(list(wanted), payloads)):
+                    if payload is None:
+                        if now < deadline[k]:
+                            continue
+                        if attempt[k] + 1 < att_max(k):
+                            telemetry.counter_add("swarm.reelections")
+                            LAST_RESTORE_SWARM["reelections"] += 1
+                            logger.warning(
+                                "swarm server rank %d missed the %.1fs deadline "
+                                "for chunk %d of %s; re-electing rank %d "
+                                "(attempt %d)",
+                                plan.orders[k][attempt[k]],
+                                deadline_s,
+                                k,
+                                plan.path,
+                                plan.orders[k][attempt[k] + 1],
+                                attempt[k] + 1,
+                            )
+                            attempt[k] += 1
+                            deadline[k] = now + deadline_s
+                        else:
+                            wanted.remove(k)
+                            await take_direct(k, "re-election budget exhausted")
+                            await ack_once(k)
                         continue
-                    if attempt[k] + 1 < att_max(k):
-                        telemetry.counter_add("swarm.reelections")
-                        LAST_RESTORE_SWARM["reelections"] += 1
-                        logger.warning(
-                            "swarm server rank %d missed the %.1fs deadline "
-                            "for chunk %d of %s; re-electing rank %d "
-                            "(attempt %d)",
-                            plan.orders[k][attempt[k]],
-                            deadline_s,
+                    wanted.remove(k)
+                    if payload[:1] == _ERR:
+                        await take_direct(
                             k,
-                            plan.path,
-                            plan.orders[k][attempt[k] + 1],
-                            attempt[k] + 1,
+                            "server rank %d reported a failed read (%s)"
+                            % (
+                                plan.orders[k][attempt[k]],
+                                payload[1:].decode(errors="replace"),
+                            ),
                         )
-                        attempt[k] += 1
-                        deadline[k] = now + deadline_s
-                    else:
-                        wanted.remove(k)
-                        await take_direct(k, "re-election budget exhausted")
                         await ack_once(k)
-                    continue
-                wanted.remove(k)
-                if payload[:1] == _ERR:
-                    await take_direct(
-                        k,
-                        "server rank %d reported a failed read (%s)"
-                        % (
-                            plan.orders[k][attempt[k]],
-                            payload[1:].decode(errors="replace"),
+                        continue
+                    data = payload[1:]
+                    problem = None
+                    if verify:
+                        problem = await loop.run_in_executor(
+                            executor,
+                            chunk_check,
+                            data,
+                            plan.shas,
+                            plan.crcs,
+                            k,
+                            plan.extents[k],
+                        )
+                    if problem is not None:
+                        await heal_from_origin(
+                            k, plan.orders[k][attempt[k]], problem
+                        )
+                    else:
+                        b, e = plan.extents[k]
+                        buf[b:e] = data
+                        have[k] = True
+                        if verify:
+                            LAST_RESTORE_SWARM["peer_chunks_verified"] += 1
+                        _note_chunk(plan.path, "peer", len(data))
+                    await ack_once(k)
+                if wanted:
+                    # Fleet wait edge: name the serving ranks this rank is
+                    # polling for, so the fleet view attributes a slow swarm
+                    # restore to the stalled server instead of "rank N is
+                    # slow". Refreshed per round (re-elections change the
+                    # server set); cleared when the want-set drains.
+                    telemetry.fleet.note_blocked(
+                        "swarm.chunk",
+                        sorted(
+                            {plan.orders[k][attempt[k]] for k in wanted}
+                            - {rank}
                         ),
                     )
-                    await ack_once(k)
-                    continue
-                data = payload[1:]
-                problem = None
-                if verify:
-                    problem = await loop.run_in_executor(
-                        executor,
-                        chunk_check,
-                        data,
-                        plan.shas,
-                        plan.crcs,
-                        k,
-                        plan.extents[k],
-                    )
-                if problem is not None:
-                    await heal_from_origin(
-                        k, plan.orders[k][attempt[k]], problem
-                    )
-                else:
-                    b, e = plan.extents[k]
-                    buf[b:e] = data
-                    have[k] = True
-                    if verify:
-                        LAST_RESTORE_SWARM["peer_chunks_verified"] += 1
-                    _note_chunk(plan.path, "peer", len(data))
-                await ack_once(k)
-            if wanted:
-                await asyncio.sleep(poll_s)
+                    await asyncio.sleep(poll_s)
+        finally:
+            telemetry.fleet.clear_blocked("swarm.chunk")
 
         # Cache-held chunks this rank neither served nor waited for still
         # need their ack — every need-set member acks every shared chunk

@@ -57,12 +57,13 @@ from .export import (
     write_chrome_trace,
 )
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
-from .progress import ProgressTracker, StallWatchdog
-from . import aggregate, artifact, health, recorder, steprecord
+from .progress import ProgressTracker, StallWatchdog, watchdog_thread
+from . import aggregate, artifact, fleet, health, recorder, steprecord
 
 __all__ = [
     "aggregate",
     "artifact",
+    "fleet",
     "health",
     "recorder",
     "steprecord",
@@ -89,6 +90,7 @@ __all__ = [
     "write_chrome_trace",
     "spans_from_chrome_trace",
     "metrics_from_chrome_trace",
+    "watchdog_thread",
 ]
 
 

@@ -249,6 +249,125 @@ def aggregate(
     }
 
 
+def fleet_view(
+    beacons: Dict[int, Dict[str, Any]],
+    world_size: Optional[int] = None,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Merge one live beacon read (``fleet.read_beacons``) into the same
+    fleet-view shape :func:`aggregate` builds from committed artifacts —
+    ranks/missing_ranks/per_rank plus the wait-edge list — so ``monitor
+    --fleet`` and ``fleet-health`` share table math with ``stats``."""
+    import time as _time
+
+    from . import fleet
+
+    t = _time.time() if now is None else now
+    ranks = sorted(beacons)
+    ws = world_size or fleet.fleet_world_size(beacons)
+    per_rank: Dict[int, Dict[str, Any]] = {}
+    edges: List[Dict[str, Any]] = []
+    for r in ranks:
+        b = beacons[r]
+        eng = b.get("engine") or {}
+        prog = b.get("progress") or {}
+        qos = b.get("qos") or {}
+        per_rank[r] = {
+            "op": b.get("op"),
+            "phase": b.get("phase"),
+            "age_s": round(t - (b.get("ts_unix") or 0.0), 3),
+            "pid": b.get("pid"),
+            "seq": b.get("seq"),
+            "engine": eng.get("engine"),
+            "engine_paused": eng.get("paused"),
+            "budget_hwm": eng.get("budget_hwm"),
+            "bytes_written": prog.get("bytes_written"),
+            "bytes_total": prog.get("bytes_total"),
+            "bytes_per_s_ewma": prog.get("bytes_per_s_ewma"),
+            "eta_s": prog.get("eta_s"),
+            "qos_demand": qos.get("demand"),
+            "anomalies": dict(b.get("anomalies") or {}),
+            "blocked_on": list(b.get("blocked_on") or []),
+        }
+        for edge in b.get("blocked_on") or []:
+            try:
+                edges.append(
+                    {
+                        "rank": r,
+                        "peer": edge[0],
+                        "site": edge[1],
+                        "age_s": edge[2],
+                    }
+                )
+            except Exception:  # noqa: BLE001 - malformed edge: skip
+                continue
+    interval = max(
+        [b.get("interval_s") or 0.0 for b in beacons.values()] + [0.0]
+    )
+    return {
+        "world_size": ws,
+        "ranks": ranks,
+        "missing_ranks": [r for r in range(ws) if r not in beacons],
+        "per_rank": per_rank,
+        "edges": sorted(edges, key=lambda e: -(e.get("age_s") or 0.0)),
+        "interval_s": interval,
+    }
+
+
+def format_fleet(view: Dict[str, Any]) -> List[str]:
+    """Human-readable live fleet table + wait edges, one string per line."""
+    lines: List[str] = []
+    lines.append(
+        f"fleet: world_size={view['world_size']}  "
+        f"beacons={len(view['ranks'])}  "
+        f"interval={view.get('interval_s', 0.0):.2f}s"
+    )
+    lines.append(
+        "rank   age_s  op          phase                 "
+        "done_GB/total_GB    MB/s    eta_s  flags"
+    )
+    for r in view["ranks"]:
+        p = view["per_rank"][r]
+        done = p.get("bytes_written")
+        total = p.get("bytes_total")
+        prog = (
+            f"{(done or 0) / 1e9:8.3f}/{(total or 0) / 1e9:<8.3f}"
+            if done is not None
+            else " " * 17
+        )
+        rate = p.get("bytes_per_s_ewma")
+        eta = p.get("eta_s")
+        flags = []
+        if p.get("engine_paused"):
+            flags.append("paused")
+        flags.extend(sorted(p.get("anomalies") or ()))
+        lines.append(
+            f"{r:4d} {p['age_s']:7.1f}  {str(p.get('op') or '-'):<10}  "
+            f"{str(p.get('phase') or '-'):<20}  {prog} "
+            f"{(rate or 0.0) / 1e6:7.1f} {eta if eta is not None else '-':>8} "
+            f" {','.join(flags)}"
+        )
+    for r in view["missing_ranks"]:
+        lines.append(f"{r:4d}       -  (no beacon)")
+    if view["edges"]:
+        lines.append("waiting on:")
+        for e in view["edges"]:
+            peer = e["peer"]
+            peer_phase = None
+            if isinstance(peer, int):
+                pp = view["per_rank"].get(peer)
+                if pp is not None:
+                    peer_phase = pp.get("phase") or pp.get("op")
+            suffix = f" (last phase: {peer_phase})" if peer_phase else ""
+            lines.append(
+                f"  rank {e['rank']} -> {peer} at {e['site']} "
+                f"for {e['age_s']:.1f}s{suffix}"
+            )
+    else:
+        lines.append("waiting on: nothing")
+    return lines
+
+
 def format_stats(agg: Dict[str, Any]) -> List[str]:
     """Human-readable fleet view, one string per output line."""
     lines: List[str] = []

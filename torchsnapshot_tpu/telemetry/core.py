@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import fleet
+
 # Parent span id for the calling context (thread + asyncio task). Shared by
 # every Telemetry instance: activation is global, so a single var suffices
 # and keeps span() allocation-free when disabled.
@@ -320,6 +322,9 @@ class PhaseTracker:
         tm = _active
         if tm is not None:
             tm.add_span(name, self.cat, sp.ts, sp.dur, attrs, tid=sp.tid)
+        # Fleet beacon feed: phase boundaries are exactly the "where is this
+        # process" signal peers need. One is-None check when the bus is off.
+        fleet.note_phase(name)
         return sp
 
     def note(self, name: str, dur_s: float, ts: Optional[float] = None,

@@ -242,6 +242,191 @@ def detect_anomalies(
     return events
 
 
+# ---------------------------------------------------------------------------
+# Fleet detectors: live beacons (fleet.py) instead of a committed step
+# series. The distinguishing power is the wait GRAPH — "rank 3 is slow"
+# (everyone blocks on 3, 3 blocks on nobody) vs "rank 3 waits on the store"
+# (3 has its own outgoing edge) vs a genuine deadlock cycle.
+# ---------------------------------------------------------------------------
+
+# A QoS pause edge older than this is starvation, not scheduling: the
+# max-pause safety valve defaults to far less.
+PAUSED_STARVATION_S = 30.0
+
+# Straggler quorum: at least half of the OTHER ranks must be blocked on R.
+STRAGGLER_QUORUM = 0.5
+
+
+def _int_edges(beacon: Dict[str, Any]) -> List[Any]:
+    """(peer, site, age_s) edges with integer (rank) peers."""
+    out = []
+    for edge in beacon.get("blocked_on") or []:
+        try:
+            peer, site, age = edge[0], edge[1], edge[2]
+        except Exception:  # noqa: BLE001 - malformed edge: skip it
+            continue
+        if isinstance(peer, int):
+            out.append((peer, site, age))
+    return out
+
+
+def detect_fleet_anomalies(
+    beacons: Dict[int, Dict[str, Any]],
+    interval_s: float,
+    world_size: Optional[int] = None,
+    now: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Run the live-fleet detectors over one beacon read.
+
+    ``interval_s`` is the publish interval the staleness fence is derived
+    from (``fleet.stale_after_s``); ``now`` (unix seconds) defaults to this
+    host's clock — beacons carry ``ts_unix`` from their publishers, so the
+    fence assumes loosely synchronized clocks (NTP-level, not TPU-level).
+
+    Events reuse the step-series event shape (kind/step/value/baseline/
+    detail/rank) with ``step=None`` — the ``fleet-health`` CLI and the
+    timeline CLI share rendering and exit-code semantics.
+    """
+    import time as _time
+
+    from . import fleet
+
+    events: List[Dict[str, Any]] = []
+    if not beacons:
+        return events
+    t = _time.time() if now is None else now
+    stale_s = fleet.stale_after_s(interval_s)
+    ws = world_size or fleet.fleet_world_size(beacons)
+
+    ages = {r: t - (b.get("ts_unix") or 0.0) for r, b in beacons.items()}
+    blocked_on_rank: Dict[int, List[int]] = {}
+    for r, b in beacons.items():
+        for peer, _site, _age in _int_edges(b):
+            blocked_on_rank.setdefault(peer, []).append(r)
+
+    # --- dead beacons: stale mid-op, or missing while someone waits on it.
+    for r, b in beacons.items():
+        if ages[r] > stale_s and b.get("op") is not None:
+            events.append(
+                _event(
+                    "dead_beacon",
+                    None,
+                    ages[r],
+                    stale_s,
+                    f"rank {r} last beaconed {ages[r]:.1f}s ago mid-op "
+                    f"({b.get('op')}/{b.get('phase')}); publisher dead or "
+                    f"wedged below the publish sites",
+                    rank=r,
+                )
+            )
+    for r in range(ws):
+        if r not in beacons and blocked_on_rank.get(r):
+            waiters = sorted(blocked_on_rank[r])
+            events.append(
+                _event(
+                    "dead_beacon",
+                    None,
+                    0.0,
+                    stale_s,
+                    f"rank {r} has no beacon at all while rank(s) "
+                    f"{waiters} wait on it",
+                    rank=r,
+                )
+            )
+
+    # --- wait cycles: DFS over the rank->rank edges.
+    graph = {
+        r: sorted({p for p, _s, _a in _int_edges(b)}) for r, b in beacons.items()
+    }
+    color: Dict[int, int] = {}
+    cycle: List[int] = []
+
+    def _dfs(node: int, path: List[int]) -> bool:
+        color[node] = 1
+        for nxt in graph.get(node, []):
+            if color.get(nxt) == 1:
+                cycle.extend(path[path.index(nxt):] + [nxt]
+                             if nxt in path else [node, nxt])
+                return True
+            if color.get(nxt, 0) == 0 and _dfs(nxt, path + [nxt]):
+                return True
+        color[node] = 2
+        return False
+
+    for r in graph:
+        if color.get(r, 0) == 0 and _dfs(r, [r]):
+            break
+    if cycle:
+        events.append(
+            _event(
+                "wait_cycle",
+                None,
+                float(len(cycle) - 1),
+                0.0,
+                "wait cycle: " + " -> ".join(str(n) for n in cycle),
+                rank=cycle[0],
+            )
+        )
+
+    # --- stragglers: a quorum of the other ranks blocked on R, R alive
+    # with no outgoing rank edge (else R's own wait is the story — noted).
+    for r, waiters in sorted(blocked_on_rank.items()):
+        others = max(1, len(beacons) - 1)
+        if len(set(waiters)) / others < STRAGGLER_QUORUM:
+            continue
+        b = beacons.get(r)
+        if b is not None and _int_edges(b):
+            continue  # R waits on another rank: the cycle/chain is the event
+        phase = (b.get("phase") or b.get("op")) if b is not None else None
+        store_wait = any(
+            isinstance(e[0], str) and e[0] == "store"
+            for e in (b.get("blocked_on") or [])
+        ) if b is not None else False
+        detail = (
+            f"rank(s) {sorted(set(waiters))} blocked on rank {r}"
+            f" (last phase: {phase})"
+        )
+        if store_wait:
+            detail += "; rank %d itself waits on the store" % r
+        events.append(
+            _event(
+                "straggler",
+                None,
+                float(len(set(waiters))),
+                others * STRAGGLER_QUORUM,
+                detail,
+                rank=r,
+            )
+        )
+
+    # --- paused starvation: a QoS pause edge held far past the safety
+    # valve while the holder's engine reports itself paused.
+    for r, b in beacons.items():
+        for edge in b.get("blocked_on") or []:
+            try:
+                peer, site, age = edge[0], edge[1], edge[2]
+            except Exception:  # noqa: BLE001
+                continue
+            if (
+                isinstance(site, str)
+                and site.startswith("qos.")
+                and isinstance(age, (int, float))
+                and age > PAUSED_STARVATION_S
+            ):
+                events.append(
+                    _event(
+                        "paused_starvation",
+                        None,
+                        float(age),
+                        PAUSED_STARVATION_S,
+                        f"rank {r} paused {age:.1f}s at {site} for {peer}",
+                        rank=r,
+                    )
+                )
+
+    return events
+
+
 def log_anomalies(events: Iterable[Dict[str, Any]]) -> None:
     """One ``logger.warning`` per anomaly *kind* (first occurrence wins):
     the job log gets a pointer, the timeline CLI has the full list."""

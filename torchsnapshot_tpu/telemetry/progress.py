@@ -176,42 +176,96 @@ class StallWatchdog:
                 return stage
         return "unknown"
 
+    def _fire(self, now: float, last_change: float) -> None:
+        self.fired += 1
+        occ = dict(self.occupancy()) if self.occupancy else {}
+        counters = self.tracker.counters()
+        payload = {
+            "event": "snapshot_stall",
+            "rank": self.rank,
+            "stalled_s": round(now - last_change, 3),
+            "stuck_stage": self._stuck_stage(occ),
+            "occupancy": occ,
+            "bytes_written": counters["bytes_written"],
+            "bytes_total": counters["bytes_total"],
+            "requests_done": counters["requests_done"],
+            "requests_total": counters["requests_total"],
+        }
+        # Peer attribution via the fleet bus: when the stall is a wait ON
+        # someone (a barrier straggler, a dead bcast reader, a held QoS
+        # class), name the peer and its last-beaconed phase instead of
+        # leaving the operator to diff per-process logs. [] when the bus
+        # is off; never fails the watchdog.
+        try:
+            from . import fleet
+
+            blocked = fleet.blocked_detail()
+        except Exception:  # noqa: BLE001 - diagnostics must not fail
+            blocked = []
+        if blocked:
+            payload["blocked_on"] = blocked
+        logger.warning(
+            "snapshot drain stalled: %s", json.dumps(payload, sort_keys=True)
+        )
+        if self.on_fire is not None:
+            self.on_fire()
+
+    def _tick(
+        self, state: Dict[str, Any]
+    ) -> None:
+        """One poll round over mutable loop state {last, last_change,
+        warned} — shared by the asyncio and thread run modes."""
+        cur = self.tracker.activity_marker()
+        now = time.monotonic()
+        if cur != state["last"]:
+            state["last"] = cur
+            state["last_change"] = now
+            state["warned"] = False
+            return
+        if not state["warned"] and now - state["last_change"] >= self.warn_s:
+            state["warned"] = True
+            self._fire(now, state["last_change"])
+
+    def _poll_s(self) -> float:
+        return max(0.02, min(self.warn_s / 4.0, 1.0))
+
     async def run(self) -> None:
         """Poll until cancelled; the owner retains and cancels this task."""
-        poll = max(0.02, min(self.warn_s / 4.0, 1.0))
-        last = self.tracker.activity_marker()
-        last_change = time.monotonic()
-        warned = False
+        poll = self._poll_s()
+        state: Dict[str, Any] = {
+            "last": self.tracker.activity_marker(),
+            "last_change": time.monotonic(),
+            "warned": False,
+        }
         while True:
             await asyncio.sleep(poll)
-            cur = self.tracker.activity_marker()
-            now = time.monotonic()
-            if cur != last:
-                last = cur
-                last_change = now
-                warned = False
-                continue
-            if not warned and now - last_change >= self.warn_s:
-                warned = True
-                self.fired += 1
-                occ = dict(self.occupancy()) if self.occupancy else {}
-                counters = self.tracker.counters()
-                logger.warning(
-                    "snapshot drain stalled: %s",
-                    json.dumps(
-                        {
-                            "event": "snapshot_stall",
-                            "rank": self.rank,
-                            "stalled_s": round(now - last_change, 3),
-                            "stuck_stage": self._stuck_stage(occ),
-                            "occupancy": occ,
-                            "bytes_written": counters["bytes_written"],
-                            "bytes_total": counters["bytes_total"],
-                            "requests_done": counters["requests_done"],
-                            "requests_total": counters["requests_total"],
-                        },
-                        sort_keys=True,
-                    ),
-                )
-                if self.on_fire is not None:
-                    self.on_fire()
+            self._tick(state)
+
+    def run_blocking(self, stop: threading.Event) -> None:
+        """Thread-mode poll loop (same tick) for synchronous waits with no
+        event loop — the commit/restore barrier holds. Runs until ``stop``
+        is set; pair with :func:`watchdog_thread`."""
+        poll = self._poll_s()
+        state: Dict[str, Any] = {
+            "last": self.tracker.activity_marker(),
+            "last_change": time.monotonic(),
+            "warned": False,
+        }
+        while not stop.wait(poll):
+            self._tick(state)
+
+
+def watchdog_thread(
+    watchdog: StallWatchdog,
+) -> "tuple[threading.Thread, threading.Event]":
+    """Start ``watchdog`` on a daemon thread; returns ``(thread, stop)``.
+    The owner sets ``stop`` and joins when the guarded wait finishes."""
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=watchdog.run_blocking,
+        args=(stop,),
+        name="torchsnapshot-stall-watchdog",
+        daemon=True,
+    )
+    thread.start()
+    return thread, stop

@@ -164,6 +164,78 @@ def parse_step_record(data: bytes) -> Dict[str, Any]:
     return parsed
 
 
+# ---------------------------------------------------------------------------
+# Rollout (restore-side) records: the read half of the step series. One
+# record per `restore(job=)` per rank — restores are where a serving fleet
+# actually spends its time, and per-rank origin/peer/cache attribution is
+# the restore-side fact worth trending (a regressing cache-hit ratio shows
+# up here steps before it shows up as wall time).
+# ---------------------------------------------------------------------------
+
+ROLLOUT_SCHEMA_VERSION = 1
+
+
+def build_rollout_record(
+    job: str,
+    step: Optional[int],
+    name: str,
+    rank: int,
+    world_size: int,
+    wall_s: float,
+    attribution: Optional[Dict[str, Any]] = None,
+    mode: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One rank's record of one restore: wall time plus where the bytes
+    came from (``origin_bytes``/``peer_bytes``/``cache_bytes``, the
+    ``LAST_RESTORE_STATS`` attribution dict)."""
+    attr = attribution or {}
+    return {
+        "schema_version": ROLLOUT_SCHEMA_VERSION,
+        "kind": "rollout",
+        "job": job,
+        "step": int(step) if step is not None else None,
+        "name": name,
+        "rank": int(rank),
+        "world_size": int(world_size),
+        "created_unix": round(time.time(), 6),
+        "wall_s": round(float(wall_s), 6),
+        "mode": mode,
+        "bytes": {
+            "origin": int(attr.get("origin_bytes", 0) or 0),
+            "peer": int(attr.get("peer_bytes", 0) or 0),
+            "cache": int(attr.get("cache_bytes", 0) or 0),
+        },
+    }
+
+
+def dumps_rollout_record(record: Dict[str, Any]) -> bytes:
+    return json.dumps(record, sort_keys=True).encode("utf-8")
+
+
+def parse_rollout_record(data: bytes) -> Dict[str, Any]:
+    """Decode + validate one rollout record; ``ValueError`` on anything
+    this library doesn't understand — callers degrade per record."""
+    try:
+        parsed = json.loads(bytes(data).decode("utf-8"))
+    except Exception as e:
+        raise ValueError(f"unparseable rollout record: {e!r}") from e
+    if not isinstance(parsed, dict):
+        raise ValueError(
+            f"rollout record is not a JSON object: {type(parsed).__name__}"
+        )
+    version = parsed.get("schema_version")
+    if not isinstance(version, int):
+        raise ValueError("rollout record has no integer schema_version")
+    if version > ROLLOUT_SCHEMA_VERSION:
+        raise ValueError(
+            f"rollout record schema v{version} is newer than this library "
+            f"understands (v{ROLLOUT_SCHEMA_VERSION})"
+        )
+    if "job" not in parsed or "name" not in parsed:
+        raise ValueError("rollout record missing job/name")
+    return parsed
+
+
 def summarize_series(series: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Scalar summary of a step series for bench artifacts / CLI headers."""
     recs: List[Dict[str, Any]] = sorted(series, key=lambda r: r.get("step", 0))

@@ -471,6 +471,53 @@ def override_step_telemetry(enabled: bool):
     return _override_env(_ENV_STEP_TELEMETRY, "1" if enabled else "0")
 
 
+_ENV_FLEET_TELEMETRY = "TORCHSNAPSHOT_TPU_FLEET_TELEMETRY"
+_ENV_FLEET_BEACON_S = "TORCHSNAPSHOT_TPU_FLEET_BEACON_S"
+
+_DEFAULT_FLEET_BEACON_S = 0.5
+
+
+def get_fleet_telemetry_mode() -> str:
+    """The live fleet telemetry bus (``telemetry/fleet.py``): each process
+    publishes a rate-limited, schema-versioned status beacon (op/phase,
+    engine rollup, progress rates, QoS demand, blocked-on peers) to its own
+    coordinator-store key, read back by ``monitor --fleet`` and the
+    ``fleet-health`` detectors. ``auto`` (the default) enables the bus only
+    when a cross-process coordinator store is configured (TCPStore env or
+    jax's coordination service) — solo/LocalStore processes publish nothing;
+    ``1`` forces it on with whatever coordinator resolves (useful for unit
+    tests over a LocalStore); ``0`` disables it entirely, restoring a
+    zero-allocation no-op at every feed site."""
+    val = os.environ.get(_ENV_FLEET_TELEMETRY, "auto").strip().lower()
+    if val in ("0", "false", "off"):
+        return "0"
+    if val in ("1", "true", "on"):
+        return "1"
+    return "auto"
+
+
+def get_fleet_beacon_s() -> float:
+    """Minimum spacing between two fleet beacon publishes from one process
+    (default 0.5 s). Bounds beacon store traffic to ~1/interval small writes
+    per process; discrete transitions (op start/end, blocked-on edges) ride
+    the next due publish rather than bypassing the limit."""
+    try:
+        return max(
+            0.05,
+            float(os.environ.get(_ENV_FLEET_BEACON_S, _DEFAULT_FLEET_BEACON_S)),
+        )
+    except ValueError:
+        return _DEFAULT_FLEET_BEACON_S
+
+
+def override_fleet_telemetry(value: str):
+    return _override_env(_ENV_FLEET_TELEMETRY, value)
+
+
+def override_fleet_beacon_s(value: float):
+    return _override_env(_ENV_FLEET_BEACON_S, str(value))
+
+
 def env_fingerprint() -> dict:
     """Every ``TORCHSNAPSHOT_TPU_*`` env var currently set, verbatim — the
     knob half of the persisted artifact's environment fingerprint. Reading
